@@ -1,0 +1,27 @@
+"""bnsgcn_tpu — TPU-native partition-parallel full-graph GNN training.
+
+A brand-new JAX/XLA framework with the capabilities of BNS-GCN
+(GATECH-EIC/BNS-GCN, MLSys 2022): full-graph GCN/GraphSAGE/GAT training over a
+partitioned graph, one device (mesh slot) per partition, with random
+Boundary-Node Sampling (BNS) compressing the per-layer halo activation
+exchange, exact full-graph gradient semantics at sampling rate 1.0, and
+unbiased stochastic aggregation below it.
+
+Design (TPU-first, not a port):
+  * one compiled train step for the whole run — static shapes everywhere,
+    per-epoch BNS resampling happens *inside* the jitted step from an epoch
+    index (no per-epoch graph reconstruction, cf. reference train.py:392);
+  * `jax.shard_map` over a ``('parts',)`` mesh; the halo exchange is a single
+    static-shape `lax.all_to_all`; sender and receiver derive identical sample
+    indices from a shared per-epoch PRNG key, so the reference's per-epoch
+    index exchange (train.py:389) costs zero communication here;
+  * gradient all-reduce (reference helper/reducer.py) falls out of the AD
+    transpose of replicated parameters under shard_map — XLA emits the psum;
+  * partitioning and all halo metadata are computed offline into padded,
+    stackable arrays (`data/artifacts.py`), replacing DGL's GraphPartitionBook
+    and the runtime boundary discovery ring (reference helper/utils.py:150-184).
+"""
+
+from bnsgcn_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,155 @@
+"""Typed run configuration.
+
+Flag-compatible with the reference CLI (reference helper/parser.py:4-61): every
+reference flag has a field of the same name here, plus TPU-specific knobs. The
+reference threads a raw argparse namespace through every module; here the
+config is a frozen dataclass created once and passed explicitly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class Config:
+    # --- data / partitioning (reference helper/parser.py:6-13,37-41) ---
+    dataset: str = "reddit"
+    data_path: str = "./dataset/"
+    part_path: str = "./partition/"
+    graph_name: str = ""
+    n_partitions: int = 2
+    partition_obj: str = "vol"          # 'vol' | 'cut'
+    partition_method: str = "metis"     # 'metis' | 'random'  (metis → native partitioner)
+    inductive: bool = False
+    skip_partition: bool = False
+
+    # --- model (reference helper/parser.py:14-31,42-46) ---
+    model: str = "graphsage"            # 'gcn' | 'graphsage' | 'gat'
+    n_layers: int = 2
+    n_hidden: int = 16
+    n_linear: int = 0
+    heads: int = 1
+    norm: Optional[str] = "layer"       # 'layer' | 'batch' | None
+    dropout: float = 0.5
+    use_pp: bool = False
+
+    # --- optimization (reference helper/parser.py:16-19,32-34) ---
+    lr: float = 1e-2
+    weight_decay: float = 0.0
+    n_epochs: int = 200
+    sampling_rate: float = 1.0
+
+    # --- bookkeeping ---
+    log_every: int = 10
+    eval: bool = True
+    fix_seed: bool = False
+    seed: int = 0
+    ckpt_path: str = "./checkpoint/"
+    results_path: str = "./results/"
+    resume: bool = False                # capability upgrade: reference is save-only (train.py:428)
+
+    # --- distributed / launcher (reference helper/parser.py:47-56) ---
+    backend: str = "xla"                # XLA collectives; 'gloo'/'mpi' accepted as aliases
+    port: int = 18118
+    master_addr: str = "127.0.0.1"
+    node_rank: int = 0
+    parts_per_node: int = 10
+    n_nodes: int = 1                    # multi-host: number of processes (jax.distributed)
+
+    # --- TPU-specific knobs (no reference equivalent) ---
+    dtype: str = "float32"              # compute dtype: 'float32' | 'bfloat16'
+    edge_chunk: int = 0                 # >0: aggregate edges in chunks of this size (bounds HBM)
+    use_pallas: bool = False            # use Pallas aggregation kernels where available
+    eval_device: str = "host"           # 'host' (background thread) | 'device'
+
+    # fields injected from partition meta.json at load time
+    # (reference helper/utils.py:134-138)
+    n_feat: int = 0
+    n_class: int = 0
+    n_train: int = 0
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def multilabel(self) -> bool:
+        return self.dataset == "yelp"
+
+    def layer_sizes(self) -> list[int]:
+        """[n_feat, hidden, ..., hidden, n_class] — reference helper/utils.py:233-241."""
+        assert self.n_layers >= 1
+        return [self.n_feat] + [self.n_hidden] * (self.n_layers - 1) + [self.n_class]
+
+    def derive_graph_name(self) -> str:
+        """Reference main.py:18-24."""
+        mode = "induc" if self.inductive else "trans"
+        return (f"{self.dataset}-{self.n_partitions}-{self.partition_method}-"
+                f"{self.partition_obj}-{mode}")
+
+
+def create_parser() -> argparse.ArgumentParser:
+    """Argparse front-end accepting the reference's flags (helper/parser.py:4-61)."""
+    p = argparse.ArgumentParser(description="bnsgcn_tpu — TPU-native BNS-GCN-capability framework")
+
+    def both(name, **kw):
+        p.add_argument(f"--{name}", f"--{name.replace('-', '_')}", **kw)
+
+    p.add_argument("--dataset", type=str, default="reddit")
+    both("data-path", type=str, default="./dataset/")
+    both("part-path", type=str, default="./partition/")
+    both("graph-name", type=str, default="")
+    p.add_argument("--model", type=str, default="graphsage",
+                   choices=["gcn", "graphsage", "gat"])
+    p.add_argument("--dropout", type=float, default=0.5)
+    p.add_argument("--lr", type=float, default=1e-2)
+    both("sampling-rate", type=float, default=1.0)
+    p.add_argument("--heads", type=int, default=1)
+    both("n-epochs", type=int, default=200)
+    both("n-partitions", type=int, default=2)
+    both("n-hidden", type=int, default=16)
+    both("n-layers", type=int, default=2)
+    both("log-every", type=int, default=10)
+    both("weight-decay", type=float, default=0.0)
+    p.add_argument("--norm", choices=["layer", "batch", "none"], default="layer")
+    both("partition-obj", choices=["vol", "cut"], default="vol")
+    both("partition-method", choices=["metis", "random"], default="metis")
+    both("n-linear", type=int, default=0)
+    both("use-pp", action="store_true", default=False)
+    p.add_argument("--inductive", action="store_true")
+    both("fix-seed", action="store_true", default=False)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--backend", type=str, default="xla")
+    p.add_argument("--port", type=int, default=18118)
+    both("master-addr", type=str, default="127.0.0.1")
+    both("node-rank", type=int, default=0)
+    both("parts-per-node", type=int, default=10)
+    p.add_argument("--skip-partition", action="store_true")
+    p.add_argument("--eval", action="store_true", dest="eval")
+    p.add_argument("--no-eval", action="store_false", dest="eval")
+    p.set_defaults(eval=True)
+    # TPU-specific
+    p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
+    both("edge-chunk", type=int, default=0)
+    both("use-pallas", action="store_true", default=False)
+    both("ckpt-path", type=str, default="./checkpoint/")
+    both("results-path", type=str, default="./results/")
+    p.add_argument("--resume", action="store_true")
+    both("n-nodes", type=int, default=1)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    d = vars(args).copy()
+    if d.get("norm") == "none":
+        d["norm"] = None
+    valid = {f.name for f in dataclasses.fields(Config)}
+    d = {k: v for k, v in d.items() if k in valid}
+    return Config(**d)
+
+
+def parse_config(argv=None) -> Config:
+    return config_from_args(create_parser().parse_args(argv))

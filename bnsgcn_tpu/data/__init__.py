@@ -1,0 +1,1 @@
+from bnsgcn_tpu.data.graph import Graph, synthetic_graph, sbm_graph

@@ -1,0 +1,175 @@
+"""Host-side graph container and synthetic graph generators.
+
+Replaces the DGL graph objects the reference passes around (reference
+helper/utils.py:37-70). Everything is plain numpy; device arrays are produced
+only by the partition artifacts (`artifacts.py`) and the trainer.
+
+Canonical form matches the reference's dataset canonicalization
+(helper/utils.py:67-69): edge data cleared, self-loops removed then re-added,
+so every node has in_deg >= 1 and out_deg >= 1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class Graph:
+    """Directed graph in COO form with node features/labels/masks.
+
+    Edges are (src, dst): a message flows src -> dst, aggregation happens at
+    dst (the reference's DGL `update_all(copy_u, sum)` over ('_U','_E','_V')).
+    """
+
+    n_nodes: int
+    src: np.ndarray                    # [E] int64
+    dst: np.ndarray                    # [E] int64
+    feat: np.ndarray                   # [N, F] float32
+    label: np.ndarray                  # [N] int64 (single-label) or [N, C] float32 (multi-label)
+    train_mask: np.ndarray             # [N] bool
+    val_mask: np.ndarray               # [N] bool
+    test_mask: np.ndarray              # [N] bool
+    multilabel: bool = False
+    # cached degrees (with self-loops, i.e. canonical form)
+    _in_deg: Optional[np.ndarray] = field(default=None, repr=False)
+    _out_deg: Optional[np.ndarray] = field(default=None, repr=False)
+
+    @property
+    def n_edges(self) -> int:
+        return int(self.src.shape[0])
+
+    @property
+    def n_feat(self) -> int:
+        return int(self.feat.shape[1])
+
+    @property
+    def n_class(self) -> int:
+        # reference helper/utils.py:61-65 (multi-label aware)
+        if self.label.ndim == 1:
+            return int(self.label.max()) + 1
+        return int(self.label.shape[1])
+
+    @property
+    def n_train(self) -> int:
+        return int(self.train_mask.sum())
+
+    def in_degrees(self) -> np.ndarray:
+        if self._in_deg is None:
+            self._in_deg = np.bincount(self.dst, minlength=self.n_nodes).astype(np.int64)
+        return self._in_deg
+
+    def out_degrees(self) -> np.ndarray:
+        if self._out_deg is None:
+            self._out_deg = np.bincount(self.src, minlength=self.n_nodes).astype(np.int64)
+        return self._out_deg
+
+    def canonicalize(self) -> "Graph":
+        """Remove then add self-loops (reference helper/utils.py:67-69)."""
+        keep = self.src != self.dst
+        src = np.concatenate([self.src[keep], np.arange(self.n_nodes, dtype=np.int64)])
+        dst = np.concatenate([self.dst[keep], np.arange(self.n_nodes, dtype=np.int64)])
+        return Graph(self.n_nodes, src, dst, self.feat, self.label,
+                     self.train_mask, self.val_mask, self.test_mask, self.multilabel)
+
+    def subgraph(self, node_mask: np.ndarray) -> "Graph":
+        """Node-induced subgraph with relabeled ids (reference dgl.node_subgraph,
+        used by the inductive path helper/utils.py:76-77, 226-230)."""
+        node_mask = np.asarray(node_mask, dtype=bool)
+        new_id = np.full(self.n_nodes, -1, dtype=np.int64)
+        kept = np.nonzero(node_mask)[0]
+        new_id[kept] = np.arange(kept.shape[0])
+        ekeep = node_mask[self.src] & node_mask[self.dst]
+        return Graph(
+            n_nodes=int(kept.shape[0]),
+            src=new_id[self.src[ekeep]],
+            dst=new_id[self.dst[ekeep]],
+            feat=self.feat[kept],
+            label=self.label[kept],
+            train_mask=self.train_mask[kept],
+            val_mask=self.val_mask[kept],
+            test_mask=self.test_mask[kept],
+            multilabel=self.multilabel,
+        )
+
+    def dense_adj(self) -> np.ndarray:
+        """[N, N] dense adjacency A[dst, src] = multiplicity — tests only."""
+        a = np.zeros((self.n_nodes, self.n_nodes), dtype=np.float64)
+        np.add.at(a, (self.dst, self.src), 1.0)
+        return a
+
+
+def inductive_split(g: Graph) -> tuple[Graph, Graph, Graph]:
+    """train / train+val / full nested subgraphs (reference helper/utils.py:226-230)."""
+    train_g = g.subgraph(g.train_mask)
+    val_g = g.subgraph(g.train_mask | g.val_mask)
+    test_g = g
+    return train_g, val_g, test_g
+
+
+def _random_masks(rng: np.random.Generator, n: int,
+                  train_frac=0.6, val_frac=0.2) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    perm = rng.permutation(n)
+    n_train = int(train_frac * n)
+    n_val = int(val_frac * n)
+    train = np.zeros(n, dtype=bool)
+    val = np.zeros(n, dtype=bool)
+    test = np.zeros(n, dtype=bool)
+    train[perm[:n_train]] = True
+    val[perm[n_train:n_train + n_val]] = True
+    test[perm[n_train + n_val:]] = True
+    return train, val, test
+
+
+def synthetic_graph(n_nodes=200, avg_degree=8, n_feat=16, n_class=5,
+                    seed=0, multilabel=False, power_law=False) -> Graph:
+    """Random directed graph with features correlated to labels.
+
+    Used by tests and benchmarks in place of downloadable datasets (this
+    environment has no network egress). `power_law=True` yields a skewed
+    degree distribution closer to Reddit's.
+    """
+    rng = np.random.default_rng(seed)
+    n_edges = n_nodes * avg_degree
+    if power_law:
+        # preferential-attachment-flavored endpoints: skewed degree distribution
+        w = 1.0 / (np.arange(n_nodes) + 1.0) ** 0.5
+        w /= w.sum()
+        src = rng.choice(n_nodes, size=n_edges, p=w).astype(np.int64)
+        dst = rng.choice(n_nodes, size=n_edges, p=w).astype(np.int64)
+    else:
+        src = rng.integers(0, n_nodes, size=n_edges).astype(np.int64)
+        dst = rng.integers(0, n_nodes, size=n_edges).astype(np.int64)
+    label = rng.integers(0, n_class, size=n_nodes).astype(np.int64)
+    centers = rng.normal(size=(n_class, n_feat)).astype(np.float32)
+    feat = (centers[label] + rng.normal(scale=1.0, size=(n_nodes, n_feat))).astype(np.float32)
+    if multilabel:
+        lab = np.zeros((n_nodes, n_class), dtype=np.float32)
+        lab[np.arange(n_nodes), label] = 1.0
+        extra = rng.random((n_nodes, n_class)) < 0.2
+        label = np.maximum(lab, extra.astype(np.float32))
+    train, val, test = _random_masks(rng, n_nodes)
+    g = Graph(n_nodes, src, dst, feat, label, train, val, test, multilabel=multilabel)
+    return g.canonicalize()
+
+
+def sbm_graph(n_nodes=400, n_class=4, n_feat=16, p_in=0.05, p_out=0.002,
+              seed=0) -> Graph:
+    """Stochastic-block-model graph: communities align with labels, so a GNN
+    can actually learn — the accuracy-improves e2e test uses this."""
+    rng = np.random.default_rng(seed)
+    label = rng.integers(0, n_class, size=n_nodes).astype(np.int64)
+    same = label[:, None] == label[None, :]
+    prob = np.where(same, p_in, p_out)
+    mask = rng.random((n_nodes, n_nodes)) < prob
+    src, dst = np.nonzero(mask)
+    # symmetric edges
+    src, dst = np.concatenate([src, dst]).astype(np.int64), np.concatenate([dst, src]).astype(np.int64)
+    centers = rng.normal(size=(n_class, n_feat)).astype(np.float32)
+    feat = (centers[label] * 0.8 + rng.normal(scale=1.0, size=(n_nodes, n_feat))).astype(np.float32)
+    train, val, test = _random_masks(rng, n_nodes)
+    g = Graph(n_nodes, src, dst, feat, label, train, val, test)
+    return g.canonicalize()

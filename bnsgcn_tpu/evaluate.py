@@ -1,0 +1,88 @@
+"""Full-graph evaluation (reference train.py:22-61,427-456).
+
+The reference evaluates on the whole undistributed graph on CPU in a
+background thread. Here the eval forward is the same `apply_model` in eval
+mode (norms recomputed from the eval graph's degrees, module/layer.py:39-45),
+jitted on whichever backend the caller picks; the trainer can run it in a
+host thread to overlap with training exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bnsgcn_tpu.data.graph import Graph
+from bnsgcn_tpu.models.gnn import GraphEnv, ModelSpec, apply_model
+from bnsgcn_tpu.utils.metrics import calc_acc
+
+
+def _identity_exchange(i, h):
+    return h, None
+
+
+def build_eval_env(g: Graph, spec: ModelSpec, edge_chunk: int = 0) -> GraphEnv:
+    """Eval-path env: norms from the eval graph's own degrees
+    (module/layer.py:40-41,94)."""
+    in_deg = g.in_degrees().astype(np.float32)
+    out_deg = g.out_degrees().astype(np.float32)
+    if spec.model == "gcn":
+        in_norm = np.sqrt(in_deg)
+        out_norm = np.sqrt(out_deg)
+    else:
+        in_norm = in_deg
+        out_norm = out_deg  # unused by SAGE/GAT but harmless
+    return GraphEnv(
+        src=jnp.asarray(g.src, jnp.int32),
+        dst=jnp.asarray(g.dst, jnp.int32),
+        n_dst=g.n_nodes,
+        in_norm=jnp.asarray(in_norm),
+        out_norm=jnp.asarray(out_norm),
+        exchange=_identity_exchange,
+        training=False,
+        edge_chunk=edge_chunk,
+    )
+
+
+def full_graph_logits(params, state, spec: ModelSpec, g: Graph,
+                      edge_chunk: int = 0) -> np.ndarray:
+    env = build_eval_env(g, spec, edge_chunk)
+    feat = jnp.asarray(g.feat)
+    logits, _ = apply_model(params, state, spec, feat, env)
+    return np.asarray(jax.device_get(logits))
+
+
+def evaluate_trans(name: str, params, state, spec: ModelSpec, g: Graph,
+                   result_file: Optional[str] = None,
+                   edge_chunk: int = 0) -> tuple[float, float]:
+    """Transductive: val+test in one pass (reference train.py:44-61)."""
+    logits = full_graph_logits(params, state, spec, g, edge_chunk)
+    val_acc = calc_acc(logits[g.val_mask], np.asarray(g.label)[g.val_mask])
+    test_acc = calc_acc(logits[g.test_mask], np.asarray(g.label)[g.test_mask])
+    buf = "{:s} | Validation Accuracy {:.2%} | Test Accuracy {:.2%}".format(name, val_acc, test_acc)
+    _emit(buf, result_file)
+    return val_acc, test_acc
+
+
+def evaluate_induc(name: str, params, state, spec: ModelSpec, g: Graph,
+                   mode: str, result_file: Optional[str] = None,
+                   edge_chunk: int = 0) -> float:
+    """Inductive: evaluate `mode` ('val'|'test') mask on subgraph g
+    (reference train.py:22-41)."""
+    logits = full_graph_logits(params, state, spec, g, edge_chunk)
+    mask = g.val_mask if mode == "val" else g.test_mask
+    acc = calc_acc(logits[mask], np.asarray(g.label)[mask])
+    buf = "{:s} | Accuracy {:.2%}".format(name, acc)
+    _emit(buf, result_file)
+    return acc
+
+
+def _emit(buf: str, result_file: Optional[str]):
+    print(buf)
+    if result_file is not None:
+        with open(result_file, "a+") as f:
+            f.write(buf + "\n")

@@ -1,0 +1,1 @@
+from bnsgcn_tpu.models.gnn import ModelSpec, GraphEnv, init_params, apply_model, spec_from_config

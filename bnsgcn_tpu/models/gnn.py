@@ -1,0 +1,330 @@
+"""GCN / GraphSAGE / GAT as pure functions over explicit parameter pytrees.
+
+Semantics mirror the reference model layer-for-layer (module/model.py,
+module/layer.py, module/sync_bn.py) but the implementation is JAX-native:
+aggregation is gather+segment_sum (ops/spmm.py), the halo exchange is injected
+via `GraphEnv.exchange` (a shard_map collective in distributed training, the
+identity on a single device), and cross-partition BatchNorm moments travel by
+`lax.psum` instead of a custom autograd.Function.
+
+Reference math preserved exactly:
+  * GCN train: h/out_norm -> copy_u/sum -> /in_norm -> linear
+    (module/layer.py:26-46); eval recomputes norms as sqrt(graph degrees).
+  * GraphSAGE: linear1(h_self) + linear2(sum(h_nbr)/in_deg) with the *global*
+    in-degree (module/layer.py:79-103, train.py:380); use_pp layer 0 is a
+    single Linear(2*in, out) over the precomputed [feat, mean_nbr] concat.
+  * GAT: DGL-GATConv equivalent (shared fc, additive attention, leaky_relu 0.2,
+    edge softmax, feat/attn dropout, bias), mean over heads
+    (module/model.py:102,111-132). Absent sampled halos are removed from the
+    softmax by an edge mask — the static-shape replacement for the reference's
+    per-epoch bipartite graph rebuild (train.py:256-281).
+  * layer stack: dropout -> exchange -> layer -> norm -> activation with
+    `n_linear` dense tail layers (module/model.py:42-58).
+  * SyncBatchNorm: moments summed over all real local rows, psum'd across
+    parts, normalized by whole_size = global n_train (module/sync_bn.py:15-22).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bnsgcn_tpu.ops.spmm import agg_mean, agg_sum, segment_softmax
+from bnsgcn_tpu.config import Config
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    model: str                         # 'gcn' | 'graphsage' | 'gat'
+    layer_sizes: tuple[int, ...]       # (n_feat, hidden, ..., n_class)
+    n_linear: int = 0
+    norm: Optional[str] = "layer"
+    dropout: float = 0.5
+    use_pp: bool = False
+    heads: int = 1
+    train_size: int = 0                # global n_train, for SyncBN whole_size
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layer_sizes) - 1
+
+    @property
+    def n_graph_layers(self) -> int:
+        return self.n_layers - self.n_linear
+
+
+def spec_from_config(cfg: Config) -> ModelSpec:
+    # GAT is always use_pp in the reference trainer (train.py:222)
+    use_pp = True if cfg.model == "gat" else cfg.use_pp
+    return ModelSpec(
+        model=cfg.model,
+        layer_sizes=tuple(cfg.layer_sizes()),
+        n_linear=cfg.n_linear,
+        norm=cfg.norm,
+        dropout=cfg.dropout,
+        use_pp=use_pp,
+        heads=cfg.heads,
+        train_size=cfg.n_train,
+    )
+
+
+@dataclass
+class GraphEnv:
+    """Everything a forward pass needs to know about the (local) graph.
+
+    Index space: edge endpoints index the *extended* node array
+    [inner nodes ; halo slots]; `dst` always lands in [0, n_dst] where n_dst is
+    the inner count (dst == n_dst is the padded-edge trash row).
+    """
+    src: jax.Array                     # [E] int32, extended index space
+    dst: jax.Array                     # [E] int32
+    n_dst: int
+    in_norm: jax.Array                 # [n_dst] float — GCN: sqrt(in_deg); SAGE: in_deg
+    out_norm: Optional[jax.Array]      # [n_src_ext] float — GCN: sqrt(out_deg) incl. halos
+    exchange: Callable[[int, jax.Array], tuple[jax.Array, Optional[jax.Array]]]
+    # exchange(layer, h[n_dst, d]) -> (h_ext [n_src_ext, d], presence [n_src_ext] bool|None)
+    gat_feat0: Optional[tuple[jax.Array, Optional[jax.Array]]] = None
+    training: bool = True
+    rng: Optional[jax.Array] = None
+    edge_chunk: int = 0
+    axis_name: Optional[str] = None    # mesh axis for SyncBN psum
+    inner_mask: Optional[jax.Array] = None  # [n_dst] bool, real (non-padded) rows
+
+
+# ----------------------------------------------------------------------------
+# init
+# ----------------------------------------------------------------------------
+
+def _uniform(key, shape, bound, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def _linear_init(key, fan_in, fan_out, dtype=jnp.float32):
+    """uniform(-1/sqrt(fan_in), +1/sqrt(fan_in)) for W and b — the reference's
+    reset_parameters (module/layer.py:20-24) and torch.nn.Linear default."""
+    kw, kb = jax.random.split(key)
+    bound = 1.0 / (fan_in ** 0.5)
+    return {"w": _uniform(kw, (fan_in, fan_out), bound, dtype),
+            "b": _uniform(kb, (fan_out,), bound, dtype)}
+
+
+def _xavier_normal(key, shape, fan_in, fan_out, gain, dtype=jnp.float32):
+    std = gain * (2.0 / (fan_in + fan_out)) ** 0.5
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def init_params(key: jax.Array, spec: ModelSpec, dtype=jnp.float32):
+    """Returns (params, state). `state` holds SyncBN running stats."""
+    params: dict[str, Any] = {}
+    state: dict[str, Any] = {}
+    keys = jax.random.split(key, spec.n_layers)
+    for i in range(spec.n_layers):
+        fin, fout = spec.layer_sizes[i], spec.layer_sizes[i + 1]
+        name = f"layer_{i}"
+        if i >= spec.n_graph_layers:                    # dense tail
+            params[name] = _linear_init(keys[i], fin, fout, dtype)
+        elif spec.model == "gcn":
+            params[name] = _linear_init(keys[i], fin, fout, dtype)
+        elif spec.model == "graphsage":
+            if spec.use_pp and i == 0:
+                # precompute doubles layer-0 input width (module/layer.py:59)
+                params[name] = _linear_init(keys[i], 2 * fin, fout, dtype)
+            else:
+                k1, k2 = jax.random.split(keys[i])
+                params[name] = {"linear1": _linear_init(k1, fin, fout, dtype),
+                                "linear2": _linear_init(k2, fin, fout, dtype)}
+        elif spec.model == "gat":
+            kf, kl, kr = jax.random.split(keys[i], 3)
+            h = spec.heads
+            params[name] = {
+                "w": _xavier_normal(kf, (fin, h * fout), fin, h * fout, 2.0 ** 0.5, dtype),
+                "attn_l": _xavier_normal(kl, (h, fout), fout, 1, 2.0 ** 0.5, dtype),
+                "attn_r": _xavier_normal(kr, (h, fout), fout, 1, 2.0 ** 0.5, dtype),
+                "bias": jnp.zeros((h * fout,), dtype),
+            }
+        else:
+            raise ValueError(spec.model)
+        if i < spec.n_layers - 1 and spec.norm is not None:
+            if spec.norm == "layer":
+                params[f"norm_{i}"] = {"scale": jnp.ones((fout,), dtype),
+                                       "bias": jnp.zeros((fout,), dtype)}
+            elif spec.norm == "batch":
+                params[f"norm_{i}"] = {"scale": jnp.ones((fout,), dtype),
+                                       "bias": jnp.zeros((fout,), dtype)}
+                state[f"norm_{i}"] = {"mean": jnp.zeros((fout,), jnp.float32),
+                                      "var": jnp.ones((fout,), jnp.float32)}
+    return params, state
+
+
+# ----------------------------------------------------------------------------
+# building blocks
+# ----------------------------------------------------------------------------
+
+def _dropout(h, rate, rng, training):
+    if not training or rate <= 0.0 or rng is None:
+        return h
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, h.shape)
+    return jnp.where(mask, h / keep, 0.0).astype(h.dtype)
+
+
+def _layer_norm(p, h, eps=1e-5):
+    mu = h.mean(-1, keepdims=True)
+    var = ((h - mu) ** 2).mean(-1, keepdims=True)
+    return (h - mu) / jnp.sqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _sync_batch_norm(p, st, h, env: GraphEnv, whole_size, momentum=0.1, eps=1e-5):
+    """module/sync_bn.py:10-28 — moments over all real rows of all parts,
+    normalized by whole_size (= global n_train in the reference trainer)."""
+    if env.training:
+        if whole_size <= 0:
+            raise ValueError("SyncBatchNorm requires train_size (global n_train) > 0; "
+                             "is n_train missing from the partition meta?")
+        hm = h if env.inner_mask is None else jnp.where(env.inner_mask[:, None], h, 0.0)
+        sum_x = hm.sum(0)
+        sum_x2 = (hm * hm).sum(0)
+        if env.axis_name is not None:
+            sum_x = jax.lax.psum(sum_x, env.axis_name)
+            sum_x2 = jax.lax.psum(sum_x2, env.axis_name)
+        mean = sum_x / whole_size
+        var = (sum_x2 - mean * sum_x) / whole_size
+        new_st = {"mean": (1 - momentum) * st["mean"] + momentum * jax.lax.stop_gradient(mean),
+                  "var": (1 - momentum) * st["var"] + momentum * jax.lax.stop_gradient(var)}
+    else:
+        mean, var = st["mean"], st["var"]
+        new_st = st
+    x_hat = (h - mean) / jnp.sqrt(var + eps)
+    return x_hat * p["scale"] + p["bias"], new_st
+
+
+def _linear(p, h):
+    return h @ p["w"] + p["b"]
+
+
+def _gcn_layer(p, h_ext, env: GraphEnv):
+    """Symmetric-norm SpMM then linear (module/layer.py:26-46)."""
+    h = h_ext / env.out_norm[:, None]
+    s = agg_sum(h, env.src, env.dst, env.n_dst, env.edge_chunk)
+    return _linear(p, s / env.in_norm[:, None])
+
+
+def _sage_layer(p, h_dst, h_ext, env: GraphEnv):
+    """linear1(self) + linear2(sum(nbrs)/in_deg) (module/layer.py:79-92)."""
+    ah = agg_mean(h_ext, env.src, env.dst, env.n_dst, env.in_norm, env.edge_chunk)
+    return _linear(p["linear1"], h_dst) + _linear(p["linear2"], ah)
+
+
+def _gat_layer(p, h_dst, h_ext, presence, env: GraphEnv, heads, out_feats,
+               rng, dropout, training, negative_slope=0.2):
+    """DGL-GATConv equivalent over the extended (inner+halo) node space.
+
+    `presence` masks softmax contributions of halo slots that were not sampled
+    this epoch (and of padded edges) — reference semantics where unsampled
+    halos simply don't appear in the constructed graph (train.py:256-281).
+    """
+    r1 = r2 = r3 = None
+    if training and rng is not None:
+        r1, r2, r3 = jax.random.split(rng, 3)
+    h_ext = _dropout(h_ext, dropout, r1, training)       # feat_drop
+    z = h_ext @ p["w"]                                    # [n_ext, heads*out]
+    z = z.reshape(z.shape[0], heads, out_feats)
+    el = (z * p["attn_l"][None]).sum(-1)                  # [n_ext, heads]
+    if training and r2 is not None:
+        # dst projections from independently dropped-out dst features
+        h_d = _dropout(h_dst, dropout, r2, training)
+        zd = (h_d @ p["w"]).reshape(h_dst.shape[0], heads, out_feats)
+    else:
+        # eval: h_dst is a prefix of h_ext and dropout is off — reuse z
+        zd = z[:h_dst.shape[0]]
+    er = (zd * p["attn_r"][None]).sum(-1)                 # [n_dst, heads]
+    er_pad = jnp.concatenate([er, jnp.zeros((1, heads), er.dtype)], 0)
+    e = el[env.src] + er_pad[jnp.minimum(env.dst, env.n_dst)]
+    e = jax.nn.leaky_relu(e, negative_slope)
+    edge_mask = None
+    if presence is not None:
+        edge_mask = presence[env.src]
+    alpha = segment_softmax(e, env.dst, env.n_dst, mask=edge_mask)
+    alpha = _dropout(alpha, dropout, r3, training)        # attn_drop
+    msg = z[env.src] * alpha[:, :, None]                  # [E, heads, out]
+    out = jax.ops.segment_sum(msg.reshape(msg.shape[0], heads * out_feats),
+                              env.dst, num_segments=env.n_dst + 1)[:env.n_dst]
+    out = out + p["bias"]
+    return out.reshape(env.n_dst, heads, out_feats)
+
+
+# ----------------------------------------------------------------------------
+# full forward
+# ----------------------------------------------------------------------------
+
+def apply_model(params, state, spec: ModelSpec, feat, env: GraphEnv):
+    """Forward pass. Returns (logits [n_dst, n_class], new_state).
+
+    In training mode `feat` is the (possibly precomputed) per-partition inner
+    feature block; in eval mode it is the raw full-graph features and
+    `env.exchange` is the identity.
+    """
+    h = feat
+    new_state = dict(state)
+    rngs = [None] * spec.n_layers
+    if env.training and env.rng is not None:
+        rngs = list(jax.random.split(env.rng, spec.n_layers))
+
+    for i in range(spec.n_layers):
+        name = f"layer_{i}"
+        p = params[name]
+        is_graph_layer = i < spec.n_graph_layers
+
+        if spec.model in ("gcn", "graphsage"):
+            # dropout -> (exchange) -> layer   (module/model.py:44-51,79-86)
+            h = _dropout(h, spec.dropout, rngs[i], env.training)
+            if not is_graph_layer:
+                h = _linear(p, h)
+            elif env.training and spec.use_pp and i == 0:
+                # precomputed layer 0: pure dense matmul (module/layer.py:29-30,83-84)
+                h = _linear(p, h)
+            else:
+                h_ext, _ = env.exchange(i, h)
+                if spec.model == "gcn":
+                    h = _gcn_layer(p, h_ext, env)
+                elif (not env.training) and spec.use_pp and i == 0:
+                    # eval pp layer 0: cat(feat, mean) @ W  (module/layer.py:99-100)
+                    ah = agg_mean(h_ext, env.src, env.dst, env.n_dst, env.in_norm,
+                                  env.edge_chunk)
+                    h = _linear(p, jnp.concatenate([h[:env.n_dst], ah], 1))
+                else:
+                    h = _sage_layer(p, h[:env.n_dst], h_ext, env)
+        elif spec.model == "gat":
+            out_feats = spec.layer_sizes[i + 1]
+            if is_graph_layer:
+                if env.training:
+                    if i == 0 and spec.use_pp:
+                        assert env.gat_feat0 is not None
+                        h_ext, presence = env.gat_feat0
+                        h_d = h[:env.n_dst] if h.shape[0] > env.n_dst else h
+                    else:
+                        h_ext, presence = env.exchange(i, h)
+                        h_d = h
+                else:
+                    h_ext, presence, h_d = h, None, h
+                h = _gat_layer(p, h_d, h_ext, presence, env, spec.heads, out_feats,
+                               rngs[i], spec.dropout, env.training)
+                h = h.mean(1)                             # mean over heads (module/model.py:124)
+            else:
+                h = _dropout(h, spec.dropout, rngs[i], env.training)
+                h = _linear(p, h)
+        else:
+            raise ValueError(spec.model)
+
+        if i < spec.n_layers - 1:
+            if spec.norm == "layer":
+                h = _layer_norm(params[f"norm_{i}"], h)
+            elif spec.norm == "batch":
+                h, new_state[f"norm_{i}"] = _sync_batch_norm(
+                    params[f"norm_{i}"], state[f"norm_{i}"], h, env, spec.train_size)
+            h = jax.nn.relu(h)
+
+    return h, new_state

@@ -1,0 +1,1 @@
+from bnsgcn_tpu.ops.spmm import gather_scatter_sum, agg_sum, agg_mean

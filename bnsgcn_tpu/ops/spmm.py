@@ -1,0 +1,88 @@
+"""Sparse neighbor aggregation — the TPU replacement for DGL's C++/CUDA SpMM.
+
+The reference's hottest compute is `update_all(copy_u('h'), sum('h'))`
+(reference module/layer.py:35-37,88-90): for every edge (u -> v), gather h[u]
+and segment-sum into v. Here that is a gather + `segment_sum` in static shape,
+optionally chunked over the edge axis with `lax.scan` so the [E, H] gathered
+intermediate never exceeds `edge_chunk * H` (HBM bound for 100M-edge graphs).
+
+Padded-edge convention (shared with the partition artifacts): `dst == n_dst`
+(one trash row, sliced off) and `src == 0` (value irrelevant). This module is
+the pure-XLA reference implementation; a Pallas kernel path is selected by the
+trainer when `Config.use_pallas` is set and the kernel module is present.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_scatter_sum(h_src: jax.Array, src: jax.Array, dst: jax.Array,
+                       n_dst: int, edge_chunk: int = 0) -> jax.Array:
+    """sum_{e:(src_e -> dst_e)} h_src[src_e]  ->  [n_dst, H].
+
+    `dst` may contain the value `n_dst` for padded edges; those land in a trash
+    row that is dropped.
+
+    edge_chunk > 0 bounds peak memory: edges are processed in chunks of that
+    size via `lax.scan` (E must be divisible by edge_chunk; artifacts pad E
+    accordingly).
+    """
+    n_out = n_dst + 1
+    if edge_chunk and src.shape[0] > edge_chunk:
+        e = src.shape[0]
+        assert e % edge_chunk == 0, f"E={e} not divisible by edge_chunk={edge_chunk}"
+        n_chunks = e // edge_chunk
+        src_c = src.reshape(n_chunks, edge_chunk)
+        dst_c = dst.reshape(n_chunks, edge_chunk)
+
+        def body(acc, sd):
+            s, d = sd
+            msg = h_src[s]
+            acc = acc.at[d].add(msg, mode="drop")
+            return acc, None
+
+        init = jnp.zeros((n_out, h_src.shape[1]), dtype=h_src.dtype)
+        out, _ = jax.lax.scan(body, init, (src_c, dst_c))
+    else:
+        out = jax.ops.segment_sum(h_src[src], dst, num_segments=n_out)
+    return out[:n_dst]
+
+
+def agg_sum(h_src, src, dst, n_dst, edge_chunk: int = 0):
+    """Plain copy_u/sum aggregation (GCN/GraphSAGE numerator)."""
+    return gather_scatter_sum(h_src, src, dst, n_dst, edge_chunk)
+
+
+def agg_mean(h_src, src, dst, n_dst, in_deg, edge_chunk: int = 0):
+    """Sum aggregation divided by a caller-provided in-degree.
+
+    The reference's GraphSAGE mean uses the *global* in-degree stored as ndata
+    before partitioning (reference helper/utils.py:92-93, train.py:380,
+    module/layer.py:85-91) — NOT the degree of the sampled subgraph; that is
+    what makes BNS unbiased for the mean aggregator.
+    """
+    s = gather_scatter_sum(h_src, src, dst, n_dst, edge_chunk)
+    return s / in_deg[:, None]
+
+
+def segment_softmax(scores: jax.Array, dst: jax.Array, n_dst: int,
+                    mask: jax.Array | None = None) -> jax.Array:
+    """Numerically-stable softmax over edges grouped by destination.
+
+    Replaces DGL's C++ edge_softmax used by GATConv (reference
+    module/model.py:102). `scores`: [E, heads]; `mask`: [E] bool — masked
+    edges (absent sampled halos, padding) get zero weight.
+    """
+    n_out = n_dst + 1
+    neg = jnp.asarray(-1e30, dtype=scores.dtype)
+    s = scores if mask is None else jnp.where(mask[:, None], scores, neg)
+    smax = jax.ops.segment_max(s, dst, num_segments=n_out)
+    smax = jnp.where(jnp.isfinite(smax), smax, 0.0)
+    ex = jnp.exp(s - smax[dst])
+    if mask is not None:
+        ex = jnp.where(mask[:, None], ex, 0.0)
+    denom = jax.ops.segment_sum(ex, dst, num_segments=n_out)
+    denom = jnp.maximum(denom, jnp.asarray(1e-16, dtype=scores.dtype))
+    return ex / denom[dst]

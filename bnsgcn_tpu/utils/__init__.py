@@ -1,0 +1,2 @@
+from bnsgcn_tpu.utils.metrics import calc_acc, micro_f1
+from bnsgcn_tpu.utils.timers import CommTimer, EpochTimer, device_memory_stats

@@ -1,0 +1,90 @@
+"""Timing + device-memory observability.
+
+The reference's CommTimer (helper/timer/comm_timer.py) wraps wall-clock spans
+around every transfer. Under XLA a span inside a jitted step is meaningless;
+instead the trainer measures (a) whole-epoch wall time after block_until_ready
+and (b) communication time by executing a compiled exchange-only program on
+identical inputs in profiling rounds. This module provides the bookkeeping
+plus peak-HBM reporting equivalent to print_memory (helper/utils.py:244-250).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+import jax
+import numpy as np
+
+
+class CommTimer:
+    """Named non-reentrant spans, summed per epoch (helper/timer/comm_timer.py)."""
+
+    def __init__(self):
+        self._time: dict[str, float] = {}
+        self._start: dict[str, float] = {}
+
+    @contextmanager
+    def timer(self, name: str):
+        if name in self._start:
+            raise RuntimeError(f"span {name!r} already running")
+        self._start[name] = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._time[name] = self._time.get(name, 0.0) + time.perf_counter() - self._start.pop(name)
+
+    def tot_time(self) -> float:
+        return sum(self._time.values())
+
+    def clear(self):
+        self._time.clear()
+        self._start.clear()
+
+
+class EpochTimer:
+    """Per-epoch Time/Comm/Reduce accumulators with warmup exclusion
+    (reference train.py:366,415-423: first `warmup` epochs dropped)."""
+
+    def __init__(self, warmup: int = 5):
+        self.warmup = warmup
+        self.train_dur: list[float] = []
+        self.comm_dur: list[float] = []
+        self.reduce_dur: list[float] = []
+
+    def record(self, epoch: int, train_t: float, comm_t: float = 0.0, reduce_t: float = 0.0):
+        if epoch >= self.warmup:
+            self.train_dur.append(train_t)
+            self.comm_dur.append(comm_t)
+            self.reduce_dur.append(reduce_t)
+
+    def means(self) -> tuple[float, float, float]:
+        m = lambda xs: float(np.mean(xs)) if xs else 0.0
+        return m(self.train_dur), m(self.comm_dur), m(self.reduce_dur)
+
+
+def device_memory_stats() -> dict:
+    """Peak/current HBM per device (reference print_memory equivalent)."""
+    out = {}
+    for d in jax.devices():
+        try:
+            s = d.memory_stats()
+        except Exception:
+            s = None
+        if s:
+            out[str(d)] = {
+                "bytes_in_use": s.get("bytes_in_use", 0),
+                "peak_bytes_in_use": s.get("peak_bytes_in_use", 0),
+                "bytes_limit": s.get("bytes_limit", 0),
+            }
+    return out
+
+
+def format_memory_stats() -> str:
+    lines = []
+    for dev, s in device_memory_stats().items():
+        lines.append(
+            f"{dev}: current {s['bytes_in_use'] / 2**20:.2f} MB, "
+            f"peak {s['peak_bytes_in_use'] / 2**20:.2f} MB, "
+            f"limit {s['bytes_limit'] / 2**20:.2f} MB")
+    return "\n".join(lines) if lines else "(no device memory stats available)"
