@@ -1,0 +1,165 @@
+"""The halo (boundary-activation) exchange — the heart of partition parallelism.
+
+TPU-native redesign of the reference feature buffer (helper/feature_buffer.py):
+
+  * one static-shape tiled `lax.all_to_all` over the 'parts' mesh axis
+    replaces the gloo irecv/isend ring + pinned staging + deferred-send queues
+    (helper/feature_buffer.py:102-129) and the MPI all_to_all (:132-153);
+  * the BNS sample for the epoch is computed once per step on *both* endpoints
+    from a shared key (`parallel/sampling.py`), replacing the per-epoch index
+    exchange (reference train.py:389);
+  * sampled activations are scaled by 1/ratio on the sender
+    (helper/feature_buffer.py:117,143) and scattered into fixed per-peer halo
+    slot blocks; unsampled slots stay zero, which under sum-aggregation over
+    the *full* static halo edge list reproduces exactly the reference's
+    aggregation over the per-epoch sampled subgraph (train.py:256-281) — no
+    graph reconstruction, ever;
+  * the backward pass needs no grad hooks (helper/feature_buffer.py:97-98,
+    169-182): JAX AD transposes gather -> all_to_all -> scatter-add into
+    scatter-add -> all_to_all -> gather, which is precisely the reference's
+    gloo backward including the 1/ratio rescale (:129).
+
+Slot layout (see data/artifacts.py): extended row `pad_inner + q*pad_b + k`
+on part j holds the k-th entry of q's boundary list toward j.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bnsgcn_tpu.parallel.sampling import identity_sample, pair_key, pair_sample
+
+
+@dataclass(frozen=True)
+class HaloSpec:
+    """Static exchange geometry (python ints only — safe to close over in jit).
+
+    The replicated device tables (n_b, send_size, inv_ratio) travel separately
+    as a `tables` dict argument through shard_map with spec P()."""
+    n_parts: int
+    pad_inner: int
+    pad_boundary: int                  # B_pad: per-pair boundary padding
+    pad_send: int                      # S_pad: per-pair send padding (<= B_pad)
+    axis_name: str = "parts"
+    exact: bool = False                # rate == 1.0: identity ordering, no top_k
+
+    @property
+    def n_halo(self) -> int:
+        return self.n_parts * self.pad_boundary
+
+
+def make_halo_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
+                   rate: float, axis_name: str = "parts"
+                   ) -> tuple[HaloSpec, dict]:
+    """Derive fixed send sizes and ratios from boundary sizes + sampling rate
+    (reference get_send_size/get_recv_size, train.py:107-131).
+
+    Returns (spec, tables): `tables` = {n_b, send_size, inv_ratio} device
+    arrays, replicated across the mesh."""
+    n_b = np.asarray(n_b, dtype=np.int64)
+    P = n_b.shape[0]
+    exact = rate >= 1.0
+    send_size = n_b if exact else (rate * n_b).astype(np.int64)
+    ratio = np.where(n_b > 0, send_size / np.maximum(n_b, 1), 0.0)
+    inv_ratio = np.where(ratio > 0, 1.0 / np.maximum(ratio, 1e-30), 0.0)
+    # S_pad: one uniform per-pair send width; multiple of 8 for lane friendliness
+    pad_send = max(1, int(send_size.max())) if send_size.size else 1
+    pad_send = min(((pad_send + 7) // 8) * 8, pad_boundary)
+    spec = HaloSpec(
+        n_parts=P, pad_inner=pad_inner, pad_boundary=pad_boundary,
+        pad_send=pad_send, axis_name=axis_name, exact=exact,
+    )
+    tables = {"n_b": jnp.asarray(n_b, jnp.int32),
+              "send_size": jnp.asarray(send_size, jnp.int32),
+              "inv_ratio": jnp.asarray(inv_ratio, jnp.float32)}
+    return spec, tables
+
+
+@dataclass
+class HaloPlan:
+    """Per-epoch sampling decisions, shared by every layer's exchange
+    (the reference samples once per epoch, train.py:388-390)."""
+    sel: jax.Array                     # [P, S] my boundary positions to send to each peer
+    weight: jax.Array                  # [P, S] f32: valid/ratio sender scaling
+    slots: jax.Array                   # [P, S] int32: halo slots for received rows (trash = n_halo)
+    presence: jax.Array                # [pad_inner + n_halo] bool: inner + sampled halos
+
+
+def make_halo_plan(spec: HaloSpec, tables: dict, bnd: jax.Array,
+                   epoch: jax.Array, base_key: jax.Array) -> HaloPlan:
+    """Compute this epoch's send selection and receive scatter plan.
+
+    `bnd`: [P, B_pad] — this device's boundary lists toward each peer
+    (sharded row of artifacts.bnd). Runs inside shard_map.
+    """
+    P, Bp, Sp = spec.n_parts, spec.pad_boundary, spec.pad_send
+    me = jax.lax.axis_index(spec.axis_name)
+    peers = jnp.arange(P)
+
+    n_send = tables["n_b"][me]                 # [P]
+    s_send = tables["send_size"][me]
+    n_recv = tables["n_b"][:, me]
+    s_recv = tables["send_size"][:, me]
+
+    if spec.exact:
+        pos, valid = jax.vmap(lambda n: identity_sample(n, Sp))(n_send)
+        rpos, rvalid = jax.vmap(lambda n: identity_sample(n, Sp))(n_recv)
+    else:
+        send_keys = jax.vmap(lambda j: pair_key(base_key, epoch, me, j))(peers)
+        recv_keys = jax.vmap(lambda q: pair_key(base_key, epoch, q, me))(peers)
+        pos, valid = jax.vmap(
+            lambda k, n, s: pair_sample(k, n, s, Bp, Sp))(send_keys, n_send, s_send)
+        rpos, rvalid = jax.vmap(
+            lambda k, n, s: pair_sample(k, n, s, Bp, Sp))(recv_keys, n_recv, s_recv)
+
+    sel = jnp.take_along_axis(bnd, pos.astype(bnd.dtype), axis=1)          # [P, S]
+    weight = jnp.where(valid, tables["inv_ratio"][me][:, None], 0.0)       # [P, S]
+    slots = jnp.where(rvalid, peers[:, None] * Bp + rpos, spec.n_halo)     # [P, S]
+
+    presence = jnp.zeros(spec.n_halo + 1, dtype=bool).at[slots.reshape(-1)].set(True)
+    presence = jnp.concatenate(
+        [jnp.ones(spec.pad_inner, dtype=bool), presence[:-1]])
+    return HaloPlan(sel=sel, weight=weight, slots=slots, presence=presence)
+
+
+def halo_apply(spec: HaloSpec, plan: HaloPlan, h: jax.Array) -> jax.Array:
+    """One layer's halo exchange: h [pad_inner, d] -> h_ext [pad_inner + n_halo, d].
+
+    Fully differentiable; the AD transpose is the reference's backward
+    all-to-all with scatter-add x (1/ratio) (helper/feature_buffer.py:119-129).
+    """
+    P, Sp, d = spec.n_parts, spec.pad_send, h.shape[-1]
+    send = h[plan.sel] * plan.weight[..., None]                 # [P, S, d]
+    recv = jax.lax.all_to_all(send.reshape(P * Sp, d), spec.axis_name,
+                              0, 0, tiled=True)                 # [P*S, d]
+    buf = jnp.zeros((spec.n_halo + 1, d), dtype=h.dtype)
+    buf = buf.at[plan.slots.reshape(-1)].add(recv)
+    return jnp.concatenate([h, buf[:-1]], axis=0)
+
+
+def sampled_presence(spec: HaloSpec, plan: HaloPlan) -> jax.Array:
+    """[pad_inner + n_halo] bool — which extended rows are live this epoch
+    (GAT masks absent halos out of its edge softmax with this)."""
+    return plan.presence
+
+
+def full_rate_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
+                   axis_name: str = "parts") -> tuple[HaloSpec, dict]:
+    """rate-1.0 (spec, tables) used by the precompute exchange (train.py:170-189)."""
+    return make_halo_spec(n_b, pad_inner, pad_boundary, 1.0, axis_name)
+
+
+def precompute_exchange(spec_full: HaloSpec, tables_full: dict,
+                        bnd: jax.Array, feat: jax.Array) -> jax.Array:
+    """One full-rate exchange of raw input features at setup (`use_pp`,
+    reference precompute train.py:170-189). Returns feat_ext
+    [pad_inner + n_halo, F]; aggregation per model is done by the caller."""
+    zero = jnp.zeros((), dtype=jnp.uint32)
+    plan = make_halo_plan(spec_full, tables_full, bnd, zero,
+                          jax.random.key(0))  # exact => key unused
+    return halo_apply(spec_full, plan, feat)

@@ -1,0 +1,38 @@
+"""Device mesh construction for partition parallelism.
+
+The reference maps partition rank -> process -> GPU (main.py:35-50, mpirun
+path :51-62). Here partitions map onto a 1-D ``('parts',)`` axis of a
+`jax.sharding.Mesh`; on a pod slice the axis rides ICI, and a multi-host
+papers100M-scale run lays parts over (DCN, ICI) transparently via
+`jax.distributed` + `jax.make_mesh`.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_parts_mesh(n_parts: int, devices=None) -> Mesh:
+    """1-D mesh with one mesh slot per partition.
+
+    n_parts must divide (or equal) the available device count; with fewer
+    devices than parts the caller should re-partition (no oversubscription —
+    SPMD shard_map owns the axis)."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n_parts:
+        raise ValueError(
+            f"need >= {n_parts} devices for {n_parts} partitions, have {len(devices)}; "
+            f"re-partition the graph or use a CPU mesh via "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_parts}")
+    return Mesh(np.asarray(devices[:n_parts]), ("parts",))
+
+
+def parts_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("parts"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,55 @@
+"""Boundary-Node Sampling with a shared PRNG — zero-communication BNS.
+
+The reference samples boundary subsets on the sender with numpy and ships the
+chosen indices to the receiver every epoch (train.py:225-236, 389). Here both
+endpoints of a pair (sender p, receiver j) derive the *same* uniform
+without-replacement sample from a common key `pair_key(base, epoch, p, j)`,
+so no index exchange happens at all, and sampling lives inside the one
+compiled train step.
+
+Sizes follow the reference exactly (train.py:107-119): for each ordered pair,
+send_size = int(rate * |boundary|) and ratio = send_size / |boundary| are
+fixed for the whole run — which is precisely what makes the exchange a
+static-shape collective.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pair_key(base_key: jax.Array, epoch: jax.Array, p, j) -> jax.Array:
+    """Key shared by sender p and receiver j for one epoch."""
+    k = jax.random.fold_in(base_key, epoch)
+    k = jax.random.fold_in(k, p)
+    return jax.random.fold_in(k, j)
+
+
+def pair_sample(key: jax.Array, n_valid: jax.Array, s_valid: jax.Array,
+                pad_b: int, pad_s: int) -> tuple[jax.Array, jax.Array]:
+    """Uniform random s_valid-subset of positions [0, n_valid), static shape.
+
+    Returns (positions [pad_s] int32, valid [pad_s] bool). Implementation:
+    random scores on the n_valid real positions (+2 on padding), take the
+    pad_s smallest — the first s_valid of a uniform random permutation of the
+    valid positions is exactly a uniform without-replacement sample
+    (reference semantics: np.random.choice(replace=False), train.py:233).
+
+    Deterministic in (key, n_valid, s_valid): sender and receiver compute
+    identical results with zero communication. Requires s_valid <= n_valid
+    and pad_s <= pad_b.
+    """
+    scores = jax.random.uniform(key, (pad_b,))
+    scores = jnp.where(jnp.arange(pad_b) < n_valid, scores, 2.0)
+    _, idx = jax.lax.top_k(-scores, pad_s)
+    valid = jnp.arange(pad_s) < s_valid
+    return idx.astype(jnp.int32), valid
+
+
+def identity_sample(n_valid: jax.Array, pad_s: int) -> tuple[jax.Array, jax.Array]:
+    """Full-rate 'sample': positions 0..pad_s with the first n_valid marked
+    valid. Used at sampling_rate=1.0 and by the precompute exchange — keeps
+    exact runs deterministic and skips the top_k."""
+    pos = jnp.arange(pad_s, dtype=jnp.int32)
+    return pos, pos < n_valid

@@ -1,0 +1,242 @@
+"""Distributed trainer: one compiled train step over a ('parts',) mesh.
+
+Replaces the reference's `run()` epoch loop body (train.py:385-425). Per
+epoch the host feeds only an epoch index — BNS resampling, halo exchange,
+forward, backward (with its transposed exchange), gradient all-reduce and the
+Adam update are all inside a single jitted step:
+
+  reference                                   here
+  ---------                                   ----
+  select_node + index data_transfer            shared-PRNG pair_sample (in-step)
+  construct_graph per epoch (train.py:392)     static padded edges (offline)
+  ctx.buffer.update per layer                  halo_apply (lax.all_to_all)
+  grad hooks + Reducer all_reduce/synchronize  AD transpose auto-psum of
+  (helper/reducer.py)                          replicated params
+  optimizer.step()                             optax adam (in-step)
+
+Gradient semantics preserved: sum-loss / global n_train + SUM-reduce
+== full-graph mean-loss gradient (train.py:359-361, helper/reducer.py:34).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bnsgcn_tpu.config import Config
+from bnsgcn_tpu.data.artifacts import PartitionArtifacts
+from bnsgcn_tpu.models.gnn import GraphEnv, ModelSpec, apply_model, init_params
+from bnsgcn_tpu.ops.spmm import agg_mean, agg_sum
+from bnsgcn_tpu.parallel.halo import (HaloSpec, full_rate_spec, halo_apply,
+                                      make_halo_plan, make_halo_spec,
+                                      precompute_exchange)
+from bnsgcn_tpu.parallel.mesh import make_parts_mesh, parts_sharding, replicated_sharding
+
+
+# ----------------------------------------------------------------------------
+# losses (reference train.py:358-361: reduction='sum' over local train rows)
+# ----------------------------------------------------------------------------
+
+def ce_sum(logits, labels, mask):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return -jnp.sum(jnp.where(mask, ll, 0.0))
+
+
+def bce_sum(logits, labels, mask):
+    """BCEWithLogits summed over train rows x classes (yelp multi-label)."""
+    per = optax.sigmoid_binary_cross_entropy(logits, labels)
+    return jnp.sum(jnp.where(mask[:, None], per, 0.0))
+
+
+# ----------------------------------------------------------------------------
+# device data
+# ----------------------------------------------------------------------------
+
+def build_block_arrays(art: PartitionArtifacts, model: str,
+                       dtype=np.float32) -> dict[str, np.ndarray]:
+    """Stacked [P, ...] numpy arrays the train step consumes (sharded on parts)."""
+    if model == "gcn":
+        in_norm = np.sqrt(art.in_deg).astype(dtype)
+        out_norm = np.sqrt(art.out_deg_ext).astype(dtype)
+    else:
+        in_norm = art.in_deg.astype(dtype)
+        out_norm = np.ones_like(art.out_deg_ext, dtype=dtype)
+    blk = {
+        "feat": art.feat.astype(dtype),
+        "label": art.label,
+        "train_mask": art.train_mask,
+        "inner_mask": art.inner_mask,
+        "src": art.src, "dst": art.dst, "bnd": art.bnd,
+        "in_norm": in_norm, "out_norm": out_norm,
+    }
+    return blk
+
+
+def place_blocks(blk: dict, mesh: Mesh) -> dict:
+    sh = parts_sharding(mesh)
+    return {k: jax.device_put(jnp.asarray(v), sh) for k, v in blk.items()}
+
+
+def place_replicated(tree, mesh: Mesh):
+    sh = replicated_sharding(mesh)
+    return jax.tree.map(lambda v: jax.device_put(jnp.asarray(v), sh), tree)
+
+
+# ----------------------------------------------------------------------------
+# step builder
+# ----------------------------------------------------------------------------
+
+@dataclass
+class StepFns:
+    train_step: Callable      # (params, state, opt_state, epoch, blk, tables, keys) -> (...)
+    forward: Callable         # (params, state, epoch, blk, tables, keys) -> logits [P, pad_inner, C]
+    precompute: Callable      # (blk, tables_full) -> new feat [P, pad_inner, F'] (or gat cache)
+    exchange_only: Callable   # comm-isolating microbench for Comm(s) reporting
+
+
+def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
+               rng, edge_chunk: int, training: bool) -> GraphEnv:
+    return GraphEnv(
+        src=blk["src"], dst=blk["dst"], n_dst=hspec.pad_inner,
+        in_norm=blk["in_norm"], out_norm=blk["out_norm"],
+        exchange=lambda i, h: (halo_apply(hspec, plan, h), plan.presence),
+        gat_feat0=((blk["feat0_ext"], plan.presence)
+                   if spec.model == "gat" and "feat0_ext" in blk else None),
+        training=training, rng=rng, edge_chunk=edge_chunk,
+        axis_name=hspec.axis_name, inner_mask=blk["inner_mask"],
+    )
+
+
+def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
+                   mesh: Mesh, rate: Optional[float] = None) -> tuple[StepFns, HaloSpec, dict]:
+    """Returns (fns, hspec, tables). `tables` must be passed to every call."""
+    rate = cfg.sampling_rate if rate is None else rate
+    hspec, tables = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, rate)
+    hspec_full, tables_full = full_rate_spec(art.n_b, art.pad_inner, art.pad_boundary)
+    n_train = max(art.n_train, 1)
+    multilabel = art.multilabel
+    axis = hspec.axis_name
+    blk_spec = P("parts")
+    rep = P()
+
+    def local_loss(params, state, blk, tables, epoch, sample_key, drop_key):
+        blk = {k: v[0] for k, v in blk.items()}
+        plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
+        me = jax.lax.axis_index(axis)
+        rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
+        env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True)
+        logits, new_state = apply_model(params, state, spec, blk["feat"], env)
+        if multilabel:
+            ls = bce_sum(logits, blk["label"], blk["train_mask"])
+        else:
+            ls = ce_sum(logits, blk["label"], blk["train_mask"])
+        loss = jax.lax.psum(ls / n_train, axis)
+        return loss, new_state
+
+    sharded_loss = jax.shard_map(
+        local_loss, mesh=mesh,
+        in_specs=(rep, rep, blk_spec, rep, rep, rep, rep),
+        out_specs=(rep, rep))
+
+    def global_loss(params, state, blk, tables, epoch, sample_key, drop_key):
+        return sharded_loss(params, state, blk, tables, epoch, sample_key, drop_key)
+
+    tx = optax.chain(
+        optax.add_decayed_weights(cfg.weight_decay) if cfg.weight_decay else optax.identity(),
+        optax.adam(cfg.lr))
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(params, state, opt_state, epoch, blk, tables, sample_key, drop_key):
+        (loss, new_state), grads = jax.value_and_grad(global_loss, has_aux=True)(
+            params, state, blk, tables, epoch, sample_key, drop_key)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_state, opt_state, loss
+
+    def local_forward(params, state, blk, tables, epoch, sample_key, drop_key):
+        blk = {k: v[0] for k, v in blk.items()}
+        plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
+        me = jax.lax.axis_index(axis)
+        rng = None
+        if drop_key is not None:
+            rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
+        env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True)
+        logits, _ = apply_model(params, state, spec, blk["feat"], env)
+        return logits[None]
+
+    @jax.jit
+    def forward(params, state, epoch, blk, tables, sample_key, drop_key=None):
+        """Training-mode forward (per-epoch sampling active), logits per part."""
+        f = jax.shard_map(
+            partial(local_forward),
+            mesh=mesh,
+            in_specs=(rep, rep, blk_spec, rep, rep, rep, rep),
+            out_specs=blk_spec)
+        return f(params, state, blk, tables, epoch, sample_key, drop_key)
+
+    def local_precompute(blk, tables_full):
+        blk = {k: v[0] for k, v in blk.items()}
+        feat_ext = precompute_exchange(hspec_full, tables_full, blk["bnd"], blk["feat"])
+        if spec.model == "gcn":
+            # (Σ feat_u / sqrt(out_deg_u)) / sqrt(in_deg_v)  (train.py:190-199)
+            h = feat_ext / blk["out_norm"][:, None]
+            s = agg_sum(h, blk["src"], blk["dst"], hspec.pad_inner, cfg.edge_chunk)
+            out = s / blk["in_norm"][:, None]
+        elif spec.model == "graphsage":
+            # concat[feat, mean_nbr]  (train.py:200-207); note reference uses
+            # fn.mean over the constructed graph == sum / global in_deg here
+            ah = agg_mean(feat_ext, blk["src"], blk["dst"], hspec.pad_inner,
+                          blk["in_norm"], cfg.edge_chunk)
+            out = jnp.concatenate([blk["feat"], ah], axis=1)
+        elif spec.model == "gat":
+            out = feat_ext                                   # cached raw halo feats
+        else:
+            raise ValueError(spec.model)
+        return out[None]
+
+    @jax.jit
+    def precompute(blk, tables_full):
+        f = jax.shard_map(local_precompute, mesh=mesh,
+                          in_specs=(blk_spec, rep), out_specs=blk_spec)
+        return f(blk, tables_full)
+
+    def local_exchange_only(blk, tables, epoch, sample_key, width):
+        blk = {k: v[0] for k, v in blk.items()}
+        plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
+        h = jnp.zeros((hspec.pad_inner, width), dtype=jnp.float32)
+        out = halo_apply(hspec, plan, h)
+        return jnp.sum(out)[None]
+
+    def exchange_only(blk, tables, epoch, sample_key, width):
+        """Isolated halo exchange x n_graph_layers — the Comm(s) microbench."""
+        f = jax.shard_map(partial(local_exchange_only, width=width),
+                          mesh=mesh,
+                          in_specs=(blk_spec, rep, rep, rep), out_specs=blk_spec)
+        return f(blk, tables, epoch, sample_key)
+
+    fns = StepFns(train_step=train_step, forward=forward,
+                  precompute=precompute, exchange_only=jax.jit(
+                      exchange_only, static_argnames="width"))
+    return fns, hspec, tables, tables_full
+
+
+def init_training(cfg: Config, spec: ModelSpec, mesh: Mesh, seed: int = 0,
+                  dtype=jnp.float32):
+    """Replicated params / state / optimizer state (reference train.py:331-338)."""
+    params, state = init_params(jax.random.key(seed), spec, dtype)
+    tx = optax.chain(
+        optax.add_decayed_weights(cfg.weight_decay) if cfg.weight_decay else optax.identity(),
+        optax.adam(cfg.lr))
+    opt_state = tx.init(params)
+    params = place_replicated(params, mesh)
+    state = place_replicated(state, mesh)
+    opt_state = place_replicated(opt_state, mesh)
+    return params, state, opt_state
