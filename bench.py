@@ -1,0 +1,121 @@
+"""Flagship benchmark — prints ONE JSON line for the driver.
+
+Workload: the reference's headline config (BASELINE.md / reference
+scripts/reddit.sh: Reddit, GraphSAGE 4-layer hidden=256, use_pp, BNS rate 0.1,
+P=2) measured as per-chip epoch time. The real Reddit dataset is not
+downloadable here (zero egress), so the bench runs a synthetic graph matching
+one rank's share of Reddit's shape: N/2 = 116,482 nodes with Reddit's ~49
+mean out-degree (~5.8M local edges) plus a 10%-sampled halo workload — i.e.
+the same nodes/edges/feature widths rank 0 processes per epoch in the
+baseline (README.md:94-95: 0.3578 s/epoch on 2x NVIDIA >=11GB GPUs).
+
+vs_baseline = baseline_epoch_time / measured_epoch_time  (>1 == faster than
+the reference's per-GPU epoch time).
+
+Usage: python bench.py [--epochs N] [--scale S] [--dtype bf16|f32] [--json-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_EPOCH_S = 0.3578   # reference README.md:94 (rank 0, Reddit P=2 rate=0.1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--scale", type=float, default=0.5,
+                    help="fraction of Reddit's 232,965 nodes per chip (0.5 = rank share at P=2)")
+    ap.add_argument("--avg-degree", type=int, default=49)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--dtype", choices=["f32", "bf16"], default="f32")
+    ap.add_argument("--edge-chunk", type=int, default=2_000_000)
+    ap.add_argument("--json-only", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bnsgcn_tpu.config import Config
+    from bnsgcn_tpu.data.artifacts import build_artifacts
+    from bnsgcn_tpu.data.graph import synthetic_graph
+    from bnsgcn_tpu.data.partitioner import partition_graph
+    from bnsgcn_tpu.models.gnn import ModelSpec, init_params
+    from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+    from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns,
+                                    init_training, place_blocks, place_replicated)
+
+    log = (lambda *a: None) if args.json_only else (lambda *a: print(*a, file=sys.stderr))
+
+    n_nodes = max(int(232_965 * args.scale), 2000)
+    log(f"building synthetic reddit-share graph: {n_nodes} nodes x deg {args.avg_degree}")
+    t0 = time.time()
+    g = synthetic_graph(n_nodes=n_nodes, avg_degree=args.avg_degree,
+                        n_feat=602, n_class=41, seed=0, power_law=True)
+    log(f"  graph ready in {time.time() - t0:.1f}s: {g.n_edges} edges")
+
+    pid = partition_graph(g, 1)
+    art = build_artifacts(g, pid, edge_mult=args.edge_chunk)
+    cfg = Config(model="graphsage", n_layers=args.layers, n_hidden=args.hidden,
+                 use_pp=True, dropout=0.5, lr=0.01, sampling_rate=0.1,
+                 edge_chunk=args.edge_chunk,
+                 n_feat=art.n_feat, n_class=art.n_class, n_train=art.n_train)
+    sizes = (art.n_feat,) + (args.hidden,) * (args.layers - 1) + (art.n_class,)
+    spec = ModelSpec("graphsage", sizes, norm="layer", dropout=0.5,
+                     use_pp=True, train_size=art.n_train)
+
+    mesh = make_parts_mesh(1)
+    fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    blk_np = build_block_arrays(art, spec.model)
+    if args.dtype == "bf16":
+        for k in ("feat", "in_norm", "out_norm"):
+            blk_np[k] = blk_np[k].astype(np.float32)  # keep norms f32; feat cast below
+        blk_np["feat"] = blk_np["feat"].astype(jnp.bfloat16)
+    blk = place_blocks(blk_np, mesh)
+    tables_d = place_replicated(tables, mesh)
+    blk["feat"] = fns.precompute(blk, place_replicated(tables_full, mesh))
+    if args.dtype == "bf16":
+        blk["feat"] = blk["feat"].astype(dtype)
+
+    params, state = init_params(jax.random.key(0), spec, dtype=dtype)
+    params = place_replicated(params, mesh)
+    state = place_replicated(state, mesh)
+    _, _, opt = init_training(cfg, spec, mesh)
+    skey, dkey = jax.random.key(0), jax.random.key(1)
+
+    log("compiling + warmup...")
+    t0 = time.time()
+    params, state, opt, loss = fns.train_step(params, state, opt, jnp.uint32(0),
+                                              blk, tables_d, skey, dkey)
+    loss.block_until_ready()
+    log(f"  first step (compile) {time.time() - t0:.1f}s, loss={float(loss):.4f}")
+
+    times = []
+    for e in range(1, args.epochs + 1):
+        t0 = time.perf_counter()
+        params, state, opt, loss = fns.train_step(params, state, opt, jnp.uint32(e),
+                                                  blk, tables_d, skey, dkey)
+        loss.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    epoch_t = float(np.mean(times))
+    log(f"epoch time mean={epoch_t:.4f}s min={np.min(times):.4f}s "
+        f"(baseline {BASELINE_EPOCH_S}s) loss={float(loss):.4f}")
+
+    print(json.dumps({
+        "metric": "reddit_flagship_epoch_time_per_chip",
+        "value": round(epoch_t, 4),
+        "unit": "s/epoch",
+        "vs_baseline": round(BASELINE_EPOCH_S / epoch_t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

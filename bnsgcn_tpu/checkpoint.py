@@ -1,0 +1,92 @@
+"""Checkpointing with real resume.
+
+The reference is save-only — periodic `state_dict` snapshots and a final best
+model, no load path at all (train.py:428,452; SURVEY §5.4). This module is the
+capability upgrade SURVEY calls for: full training state (params, optimizer
+state, BN state, epoch counter, RNG seeds, best accuracy) round-trips through
+msgpack, so `--resume` continues a run bit-for-bit in expectation.
+
+Filenames mirror the reference's layout:
+  {ckpt_path}/{graph_name}_p{rate:.2f}_{epoch}.ckpt   (periodic)
+  {ckpt_path}/{graph_name}_final.ckpt                 (best-val model)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _to_host(tree):
+    """state_dict form (tuples -> indexed dicts) so msgpack can pack it."""
+    host = jax.tree.map(lambda x: np.asarray(x), jax.device_get(tree))
+    return serialization.to_state_dict(host)
+
+
+def save_checkpoint(path: str, *, params, opt_state=None, bn_state=None,
+                    epoch: int = 0, best_acc: float = 0.0, seed: int = 0,
+                    extra: Optional[dict] = None):
+    payload = {
+        "params": _to_host(params),
+        "opt_state": _to_host(opt_state) if opt_state is not None else {},
+        "bn_state": _to_host(bn_state) if bn_state is not None else {},
+        "epoch": epoch,
+        "best_acc": float(best_acc),
+        "seed": seed,
+        "extra": extra or {},
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    blob = serialization.msgpack_serialize(payload)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)          # atomic: no torn checkpoints on preemption
+
+
+def load_checkpoint(path: str) -> dict[str, Any]:
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def restore_into(payload: dict, params_template, opt_template=None,
+                 bn_template=None):
+    """Restore arrays into the structure of freshly-initialized templates
+    (guards against model/optimizer config drift between save and resume)."""
+    params = serialization.from_state_dict(params_template, payload["params"])
+    opt_state = (serialization.from_state_dict(opt_template, payload["opt_state"])
+                 if opt_template is not None else None)
+    bn_state = (serialization.from_state_dict(bn_template, payload["bn_state"])
+                if bn_template is not None and payload.get("bn_state") else bn_template)
+    return params, opt_state, bn_state
+
+
+def periodic_path(cfg, epoch: int) -> str:
+    name = cfg.graph_name or cfg.derive_graph_name()
+    return os.path.join(cfg.ckpt_path, f"{name}_p{cfg.sampling_rate:.2f}_{epoch}.ckpt")
+
+
+def final_path(cfg) -> str:
+    name = cfg.graph_name or cfg.derive_graph_name()
+    return os.path.join(cfg.ckpt_path, f"{name}_final.ckpt")
+
+
+def latest_checkpoint(cfg) -> Optional[str]:
+    """Most recent periodic checkpoint for --resume."""
+    name = cfg.graph_name or cfg.derive_graph_name()
+    prefix = f"{name}_p{cfg.sampling_rate:.2f}_"
+    if not os.path.isdir(cfg.ckpt_path):
+        return None
+    best_ep, best = -1, None
+    for fn in os.listdir(cfg.ckpt_path):
+        if fn.startswith(prefix) and fn.endswith(".ckpt"):
+            try:
+                ep = int(fn[len(prefix):-len(".ckpt")])
+            except ValueError:
+                continue
+            if ep > best_ep:
+                best_ep, best = ep, os.path.join(cfg.ckpt_path, fn)
+    return best

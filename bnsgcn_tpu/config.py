@@ -64,7 +64,6 @@ class Config:
     dtype: str = "float32"              # compute dtype: 'float32' | 'bfloat16'
     edge_chunk: int = 0                 # >0: aggregate edges in chunks of this size (bounds HBM)
     use_pallas: bool = False            # use Pallas aggregation kernels where available
-    eval_device: str = "host"           # 'host' (background thread) | 'device'
 
     # fields injected from partition meta.json at load time
     # (reference helper/utils.py:134-138)

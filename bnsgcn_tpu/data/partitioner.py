@@ -47,6 +47,7 @@ def bfs_partition(g: Graph, n_parts: int, seed: int = 0) -> np.ndarray:
     n = g.n_nodes
     cap = -(-n // n_parts)           # ceil
     part_id = np.full(n, -1, dtype=np.int32)
+    seen = np.zeros(n, dtype=bool)          # enqueued-or-assigned guard
     sizes = np.zeros(n_parts, dtype=np.int64)
     order = rng.permutation(n)
     cursor = 0
@@ -58,6 +59,7 @@ def bfs_partition(g: Graph, n_parts: int, seed: int = 0) -> np.ndarray:
         if cursor >= n:
             break
         q = deque([order[cursor]])
+        seen[order[cursor]] = True
         while q and sizes[p] < cap:
             u = q.popleft()
             if part_id[u] != -1:
@@ -65,8 +67,13 @@ def bfs_partition(g: Graph, n_parts: int, seed: int = 0) -> np.ndarray:
             part_id[u] = p
             sizes[p] += 1
             for v in adj[indptr[u]:indptr[u + 1]]:
-                if part_id[v] == -1:
+                if not seen[v]:
+                    seen[v] = True
                     q.append(int(v))
+        # nodes left in the queue stay available for the next region
+        for u in q:
+            if part_id[u] == -1:
+                seen[u] = False
     # any leftovers -> smallest parts
     for u in np.nonzero(part_id == -1)[0]:
         p = int(np.argmin(sizes))

@@ -1,0 +1,60 @@
+"""CLI launcher (reference main.py).
+
+Differences by design: the reference forks one process per partition and
+rendezvous over gloo/MPI (main.py:35-62); under SPMD a single process drives
+every local device, and multi-host pods use `jax.distributed.initialize`
+(--n-nodes > 1) instead of mpirun re-exec.
+
+  python -m bnsgcn_tpu.main --dataset reddit --n-partitions 8 \
+      --model graphsage --n-layers 4 --n-hidden 256 --sampling-rate 0.1 \
+      --use-pp --inductive
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from bnsgcn_tpu.config import Config, parse_config
+from bnsgcn_tpu.run import prepare_partition, run_training
+
+
+def main(argv=None):
+    cfg = parse_config(argv)
+    if not cfg.fix_seed:
+        # reference randomizes the seed unless --fix-seed (main.py:13-16)
+        cfg = cfg.replace(seed=random.randrange(1 << 31))
+    if not cfg.graph_name:
+        cfg = cfg.replace(graph_name=cfg.derive_graph_name())
+
+    if cfg.n_nodes > 1:
+        import jax
+        from jax.experimental import multihost_utils
+        jax.distributed.initialize(
+            coordinator_address=f"{cfg.master_addr}:{cfg.port}",
+            num_processes=cfg.n_nodes, process_id=cfg.node_rank)
+        # every process must share the (possibly randomized) seed: the
+        # zero-communication BNS sampling and the replicated param init both
+        # depend on it being identical everywhere
+        import numpy as np
+        seed = multihost_utils.broadcast_one_to_all(np.int64(cfg.seed))
+        cfg = cfg.replace(seed=int(seed))
+
+    if not cfg.skip_partition and cfg.node_rank == 0:
+        t0 = time.time()
+        prepare_partition(cfg)
+        print(f"partition ready in {time.time() - t0:.1f}s -> {cfg.part_path}")
+
+    if cfg.n_nodes > 1:
+        from jax.experimental import multihost_utils
+        # barrier: ranks != 0 must not read artifacts before rank 0 finishes
+        # writing them (part_path must be on a shared filesystem, or use
+        # partition_cli + --skip-partition to pre-distribute — README.md:116)
+        multihost_utils.sync_global_devices("bnsgcn_partition_ready")
+
+    res = run_training(cfg)
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -43,7 +43,9 @@ def gather_scatter_sum(h_src: jax.Array, src: jax.Array, dst: jax.Array,
             acc = acc.at[d].add(msg, mode="drop")
             return acc, None
 
-        init = jnp.zeros((n_out, h_src.shape[1]), dtype=h_src.dtype)
+        # derive init from h_src so it carries the same shard_map varying axes
+        # (a plain jnp.zeros is 'unvarying' and trips the scan VMA check)
+        init = jnp.zeros((n_out, h_src.shape[1]), dtype=h_src.dtype) + h_src[0] * 0
         out, _ = jax.lax.scan(body, init, (src_c, dst_c))
     else:
         out = jax.ops.segment_sum(h_src[src], dst, num_segments=n_out)

@@ -1,0 +1,25 @@
+"""Offline partition CLI (reference partition.py:4-16).
+
+  python -m bnsgcn_tpu.partition_cli --dataset reddit --n-partitions 8
+
+Writes the artifact dir {part_path}/{graph_name}/ (meta.json + shared.npz +
+part{p}.npz) for later `--skip-partition` runs on hosts without the full
+dataset (reference README.md:116 flow).
+"""
+
+from __future__ import annotations
+
+from bnsgcn_tpu.config import parse_config
+from bnsgcn_tpu.run import artifacts_dir, prepare_partition
+
+
+def main(argv=None):
+    cfg = parse_config(argv)
+    if not cfg.graph_name:
+        cfg = cfg.replace(graph_name=cfg.derive_graph_name())
+    prepare_partition(cfg, force=True)
+    print(f"partition artifacts written to {artifacts_dir(cfg)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -115,9 +115,19 @@ def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
     )
 
 
+def make_tx(cfg: Config) -> optax.GradientTransformation:
+    """torch.optim.Adam(lr, weight_decay) semantics: L2 added to the grad
+    before the Adam moments (reference train.py:362-364)."""
+    return optax.chain(
+        optax.add_decayed_weights(cfg.weight_decay) if cfg.weight_decay else optax.identity(),
+        optax.adam(cfg.lr))
+
+
 def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
-                   mesh: Mesh, rate: Optional[float] = None) -> tuple[StepFns, HaloSpec, dict]:
-    """Returns (fns, hspec, tables). `tables` must be passed to every call."""
+                   mesh: Mesh, rate: Optional[float] = None
+                   ) -> tuple[StepFns, HaloSpec, dict, dict]:
+    """Returns (fns, hspec, tables, tables_full); the tables dicts must be
+    passed (replicated) to every call."""
     rate = cfg.sampling_rate if rate is None else rate
     hspec, tables = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, rate)
     hspec_full, tables_full = full_rate_spec(art.n_b, art.pad_inner, art.pad_boundary)
@@ -149,9 +159,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     def global_loss(params, state, blk, tables, epoch, sample_key, drop_key):
         return sharded_loss(params, state, blk, tables, epoch, sample_key, drop_key)
 
-    tx = optax.chain(
-        optax.add_decayed_weights(cfg.weight_decay) if cfg.weight_decay else optax.identity(),
-        optax.adam(cfg.lr))
+    tx = make_tx(cfg)
 
     @partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, state, opt_state, epoch, blk, tables, sample_key, drop_key):
@@ -230,12 +238,10 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
 def init_training(cfg: Config, spec: ModelSpec, mesh: Mesh, seed: int = 0,
                   dtype=jnp.float32):
-    """Replicated params / state / optimizer state (reference train.py:331-338)."""
+    """Replicated params / state / optimizer state (reference train.py:331-338).
+    The optimizer is the same make_tx(cfg) the train step uses."""
     params, state = init_params(jax.random.key(seed), spec, dtype)
-    tx = optax.chain(
-        optax.add_decayed_weights(cfg.weight_decay) if cfg.weight_decay else optax.identity(),
-        optax.adam(cfg.lr))
-    opt_state = tx.init(params)
+    opt_state = make_tx(cfg).init(params)
     params = place_replicated(params, mesh)
     state = place_replicated(state, mesh)
     opt_state = place_replicated(opt_state, mesh)

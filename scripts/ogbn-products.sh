@@ -1,0 +1,15 @@
+#!/bin/bash
+# reference scripts/ogbn-products.sh: GraphSAGE 3x128, P=5..10, transductive.
+python -m bnsgcn_tpu.main \
+  --dataset ogbn-products \
+  --dropout 0.3 \
+  --lr 0.003 \
+  --n-partitions ${P:-10} \
+  --n-epochs 500 \
+  --model graphsage \
+  --sampling-rate 0.1 \
+  --n-layers 3 \
+  --n-hidden 128 \
+  --log-every 10 \
+  --use-pp \
+  "$@"

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Sweep P x sampling_rate (reference scripts/reddit_full.sh reproduces the
+# paper's Figures 4-6 / Table 4 grid), teeing into results/.
+mkdir -p results
+for P in 2 4 8; do
+  for RATE in 0.1 0.01 0.0; do
+    P=$P bash scripts/reddit.sh --sampling-rate $RATE --no-eval \
+      | tee results/reddit_n${P}_p${RATE}.log
+  done
+done

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Multi-host flow (reference scripts/reddit_multi_node.sh): partition once,
+# then launch one process per host with jax.distributed rendezvous.
+#   host 0:  NODE_RANK=0 bash scripts/reddit_multi_node.sh
+#   host i:  NODE_RANK=i MASTER=host0-addr bash scripts/reddit_multi_node.sh
+NODES=${NODES:-4}
+NODE_RANK=${NODE_RANK:-0}
+MASTER=${MASTER:-127.0.0.1}
+
+if [ "$NODE_RANK" = "0" ]; then
+  python -m bnsgcn_tpu.partition_cli --dataset reddit --n-partitions ${P:-40} --inductive
+fi
+
+P=${P:-40} bash scripts/reddit.sh \
+  --n-nodes $NODES --node-rank $NODE_RANK --master-addr $MASTER \
+  --skip-partition "$@"

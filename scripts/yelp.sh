@@ -1,0 +1,18 @@
+#!/bin/bash
+# reference scripts/yelp.sh: GraphSAGE 4 layers h=512 with 2 linear tail
+# layers, multi-label BCE, inductive.
+python -m bnsgcn_tpu.main \
+  --dataset yelp \
+  --dropout 0.1 \
+  --lr 0.001 \
+  --n-partitions ${P:-10} \
+  --n-epochs 3000 \
+  --model graphsage \
+  --sampling-rate 0.1 \
+  --n-layers 4 \
+  --n-linear 2 \
+  --n-hidden 512 \
+  --log-every 10 \
+  --use-pp \
+  --inductive \
+  "$@"
