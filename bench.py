@@ -1,24 +1,29 @@
 """Flagship benchmark — prints ONE JSON line for the driver.
 
-Workload: the reference's headline config (BASELINE.md / reference
-scripts/reddit.sh: Reddit, GraphSAGE 4-layer hidden=256, use_pp, BNS rate 0.1,
-P=2) measured as per-chip epoch time. The real Reddit dataset is not
-downloadable here (zero egress), so the bench runs a synthetic graph matching
-one rank's share of Reddit's shape: N/2 = 116,482 nodes with Reddit's ~49
-mean out-degree (~5.8M local edges) plus a 10%-sampled halo workload — i.e.
-the same nodes/edges/feature widths rank 0 processes per epoch in the
-baseline (README.md:94-95: 0.3578 s/epoch on 2x NVIDIA >=11GB GPUs).
+Workload: one rank's share of the reference's headline config (BASELINE.md /
+reference scripts/reddit.sh: Reddit — 232,965 nodes, ~114.6M directed edges
+(mean degree ~492), 602 features, 41 classes — GraphSAGE 4-layer hidden=256,
+use_pp, BNS rate 0.1, P=2, 0.3578 s/epoch/rank on 2x NVIDIA >=11GB GPUs,
+README.md:94-95). The real dataset is not downloadable here (zero egress), so
+a synthetic power-law graph with the same shape statistics stands in:
+scale x 232,965 nodes at the true ~492 mean degree (scale 0.5 = the P=2
+per-rank node share, ~57M local edges).
 
-vs_baseline = baseline_epoch_time / measured_epoch_time  (>1 == faster than
-the reference's per-GPU epoch time).
+vs_baseline = 0.3578 / measured_epoch_time (>1 == faster per chip than the
+reference per GPU). Compute dtype defaults to bf16 — the TPU-native choice;
+the gather unit on a single v5e caps sparse aggregation at ~72 GB/s, which
+is the known single-chip bottleneck this framework addresses by scale-out
+(BNS partition parallelism over the 'parts' mesh axis).
 
-Usage: python bench.py [--epochs N] [--scale S] [--dtype bf16|f32] [--json-only]
+Usage: python bench.py [--epochs N] [--scale S] [--avg-degree D]
+                       [--dtype bf16|f32] [--json-only]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -27,16 +32,48 @@ import numpy as np
 BASELINE_EPOCH_S = 0.3578   # reference README.md:94 (rank 0, Reddit P=2 rate=0.1)
 
 
+def _features(label: np.ndarray, n_feat=602, n_class=41) -> np.ndarray:
+    """Label-correlated features from a dedicated RNG stream — identical on
+    cold and warm runs (the cache stores only edges/labels/masks)."""
+    rng = np.random.default_rng(1234)
+    centers = rng.normal(size=(n_class, n_feat)).astype(np.float32)
+    return (centers[label] + rng.normal(
+        scale=1.0, size=(label.shape[0], n_feat))).astype(np.float32)
+
+
+def _cached_graph(n_nodes: int, avg_degree: int, cache_dir: str, log):
+    """Synthetic graph with npz edge cache (generation dominates cold runs)."""
+    from bnsgcn_tpu.data.graph import Graph, synthetic_graph
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, f"synth_{n_nodes}_{avg_degree}.npz")
+    if os.path.exists(path):
+        log(f"loading cached graph {path}")
+        z = np.load(path)
+        label = z["label"].astype(np.int64)
+        return Graph(n_nodes, z["src"].astype(np.int64), z["dst"].astype(np.int64),
+                     _features(label), label, z["train"], z["val"], z["test"])
+    t0 = time.time()
+    g = synthetic_graph(n_nodes=n_nodes, avg_degree=avg_degree, n_feat=602,
+                        n_class=41, seed=0, power_law=True)
+    g.feat = _features(g.label)
+    log(f"  graph generated in {time.time() - t0:.1f}s: {g.n_edges} edges")
+    np.savez(path, src=g.src.astype(np.int32), dst=g.dst.astype(np.int32),
+             label=g.label.astype(np.int32),
+             train=g.train_mask, val=g.val_mask, test=g.test_mask)
+    return g
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--epochs", type=int, default=8)
     ap.add_argument("--scale", type=float, default=0.5,
                     help="fraction of Reddit's 232,965 nodes per chip (0.5 = rank share at P=2)")
-    ap.add_argument("--avg-degree", type=int, default=49)
+    ap.add_argument("--avg-degree", type=int, default=492,
+                    help="mean degree (Reddit: 114.6M edges / 233k nodes ~= 492)")
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--layers", type=int, default=4)
-    ap.add_argument("--dtype", choices=["f32", "bf16"], default="f32")
-    ap.add_argument("--edge-chunk", type=int, default=2_000_000)
+    ap.add_argument("--dtype", choices=["f32", "bf16"], default="bf16")
+    ap.add_argument("--cache-dir", type=str, default="./bench_cache")
     ap.add_argument("--json-only", action="store_true")
     args = ap.parse_args()
 
@@ -45,7 +82,6 @@ def main():
 
     from bnsgcn_tpu.config import Config
     from bnsgcn_tpu.data.artifacts import build_artifacts
-    from bnsgcn_tpu.data.graph import synthetic_graph
     from bnsgcn_tpu.data.partitioner import partition_graph
     from bnsgcn_tpu.models.gnn import ModelSpec, init_params
     from bnsgcn_tpu.parallel.mesh import make_parts_mesh
@@ -55,35 +91,32 @@ def main():
     log = (lambda *a: None) if args.json_only else (lambda *a: print(*a, file=sys.stderr))
 
     n_nodes = max(int(232_965 * args.scale), 2000)
-    log(f"building synthetic reddit-share graph: {n_nodes} nodes x deg {args.avg_degree}")
-    t0 = time.time()
-    g = synthetic_graph(n_nodes=n_nodes, avg_degree=args.avg_degree,
-                        n_feat=602, n_class=41, seed=0, power_law=True)
-    log(f"  graph ready in {time.time() - t0:.1f}s: {g.n_edges} edges")
+    log(f"workload: {n_nodes} nodes x mean degree {args.avg_degree} "
+        f"(~{n_nodes * args.avg_degree / 1e6:.1f}M edges/chip), "
+        f"GraphSAGE {args.layers}x{args.hidden}, pp, dtype={args.dtype}")
+    g = _cached_graph(n_nodes, args.avg_degree, args.cache_dir, log)
 
+    t0 = time.time()
     pid = partition_graph(g, 1)
-    art = build_artifacts(g, pid, edge_mult=args.edge_chunk)
+    art = build_artifacts(g, pid)
     cfg = Config(model="graphsage", n_layers=args.layers, n_hidden=args.hidden,
                  use_pp=True, dropout=0.5, lr=0.01, sampling_rate=0.1,
-                 edge_chunk=args.edge_chunk,
                  n_feat=art.n_feat, n_class=art.n_class, n_train=art.n_train)
     sizes = (art.n_feat,) + (args.hidden,) * (args.layers - 1) + (art.n_class,)
     spec = ModelSpec("graphsage", sizes, norm="layer", dropout=0.5,
                      use_pp=True, train_size=art.n_train)
-
     mesh = make_parts_mesh(1)
     fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
+    log(f"  artifacts + ELL layouts in {time.time() - t0:.1f}s")
+
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
     blk_np = build_block_arrays(art, spec.model)
-    if args.dtype == "bf16":
-        for k in ("feat", "in_norm", "out_norm"):
-            blk_np[k] = blk_np[k].astype(np.float32)  # keep norms f32; feat cast below
-        blk_np["feat"] = blk_np["feat"].astype(jnp.bfloat16)
+    blk_np.update(fns.extra_blk)
+    for k in fns.drop_blk_keys:
+        blk_np.pop(k, None)
     blk = place_blocks(blk_np, mesh)
     tables_d = place_replicated(tables, mesh)
-    blk["feat"] = fns.precompute(blk, place_replicated(tables_full, mesh))
-    if args.dtype == "bf16":
-        blk["feat"] = blk["feat"].astype(dtype)
+    blk["feat"] = fns.precompute(blk, place_replicated(tables_full, mesh)).astype(dtype)
 
     params, state = init_params(jax.random.key(0), spec, dtype=dtype)
     params = place_replicated(params, mesh)
@@ -95,7 +128,6 @@ def main():
     t0 = time.time()
     params, state, opt, loss = fns.train_step(params, state, opt, jnp.uint32(0),
                                               blk, tables_d, skey, dkey)
-    loss.block_until_ready()
     log(f"  first step (compile) {time.time() - t0:.1f}s, loss={float(loss):.4f}")
 
     times = []
@@ -103,14 +135,16 @@ def main():
         t0 = time.perf_counter()
         params, state, opt, loss = fns.train_step(params, state, opt, jnp.uint32(e),
                                                   blk, tables_d, skey, dkey)
-        loss.block_until_ready()
+        _ = float(loss)   # force device sync through the host read
         times.append(time.perf_counter() - t0)
     epoch_t = float(np.mean(times))
+    eps = g.n_edges / epoch_t
     log(f"epoch time mean={epoch_t:.4f}s min={np.min(times):.4f}s "
-        f"(baseline {BASELINE_EPOCH_S}s) loss={float(loss):.4f}")
+        f"({eps / 1e6:.1f}M edges/s/chip; baseline {BASELINE_EPOCH_S}s/rank) "
+        f"loss={float(loss):.4f}")
 
     print(json.dumps({
-        "metric": "reddit_flagship_epoch_time_per_chip",
+        "metric": "reddit_rank_share_epoch_time_per_chip",
         "value": round(epoch_t, 4),
         "unit": "s/epoch",
         "vs_baseline": round(BASELINE_EPOCH_S / epoch_t, 3),

@@ -63,6 +63,7 @@ class Config:
     # --- TPU-specific knobs (no reference equivalent) ---
     dtype: str = "float32"              # compute dtype: 'float32' | 'bfloat16'
     edge_chunk: int = 0                 # >0: aggregate edges in chunks of this size (bounds HBM)
+    spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'segment'
     use_pallas: bool = False            # use Pallas aggregation kernels where available
 
     # fields injected from partition meta.json at load time
@@ -132,6 +133,7 @@ def create_parser() -> argparse.ArgumentParser:
     p.set_defaults(eval=True)
     # TPU-specific
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
+    p.add_argument("--spmm", type=str, default="ell", choices=["ell", "segment"])
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)
     both("ckpt-path", type=str, default="./checkpoint/")

@@ -32,7 +32,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from bnsgcn_tpu.ops.spmm import agg_mean, agg_sum, segment_softmax
+from bnsgcn_tpu.ops.spmm import agg_sum, segment_softmax
 from bnsgcn_tpu.config import Config
 
 
@@ -79,8 +79,8 @@ class GraphEnv:
     [inner nodes ; halo slots]; `dst` always lands in [0, n_dst] where n_dst is
     the inner count (dst == n_dst is the padded-edge trash row).
     """
-    src: jax.Array                     # [E] int32, extended index space
-    dst: jax.Array                     # [E] int32
+    src: Optional[jax.Array]           # [E] int32, extended index space (None when the
+    dst: Optional[jax.Array]           # ELL aggregate owns the graph structure)
     n_dst: int
     in_norm: jax.Array                 # [n_dst] float — GCN: sqrt(in_deg); SAGE: in_deg
     out_norm: Optional[jax.Array]      # [n_src_ext] float — GCN: sqrt(out_deg) incl. halos
@@ -92,6 +92,16 @@ class GraphEnv:
     edge_chunk: int = 0
     axis_name: Optional[str] = None    # mesh axis for SyncBN psum
     inner_mask: Optional[jax.Array] = None  # [n_dst] bool, real (non-padded) rows
+    aggregate: Optional[Callable] = None
+    # aggregate(h_ext [n_src_ext, d]) -> [n_dst, d]: scatter-free ELL SpMM
+    # (ops/ell.py) when set; falls back to segment_sum otherwise
+
+
+def env_agg_sum(env: "GraphEnv", h_ext: jax.Array) -> jax.Array:
+    """sum_{e:(u->v)} h_ext[u] at v via the env's preferred SpMM backend."""
+    if env.aggregate is not None:
+        return env.aggregate(h_ext)
+    return agg_sum(h_ext, env.src, env.dst, env.n_dst, env.edge_chunk)
 
 
 # ----------------------------------------------------------------------------
@@ -172,9 +182,12 @@ def _dropout(h, rate, rng, training):
 
 
 def _layer_norm(p, h, eps=1e-5):
-    mu = h.mean(-1, keepdims=True)
-    var = ((h - mu) ** 2).mean(-1, keepdims=True)
-    return (h - mu) / jnp.sqrt(var + eps) * p["scale"] + p["bias"]
+    # stats in f32 (bf16 activations would lose the variance), output in h.dtype
+    hf = h.astype(jnp.float32)
+    mu = hf.mean(-1, keepdims=True)
+    var = ((hf - mu) ** 2).mean(-1, keepdims=True)
+    out = (hf - mu) / jnp.sqrt(var + eps) * p["scale"] + p["bias"]
+    return out.astype(h.dtype)
 
 
 def _sync_batch_norm(p, st, h, env: GraphEnv, whole_size, momentum=0.1, eps=1e-5):
@@ -206,15 +219,19 @@ def _linear(p, h):
 
 
 def _gcn_layer(p, h_ext, env: GraphEnv):
-    """Symmetric-norm SpMM then linear (module/layer.py:26-46)."""
-    h = h_ext / env.out_norm[:, None]
-    s = agg_sum(h, env.src, env.dst, env.n_dst, env.edge_chunk)
-    return _linear(p, s / env.in_norm[:, None])
+    """Symmetric-norm SpMM then linear (module/layer.py:26-46).
+
+    Degree norms are f32; divisions happen in f32 but the result is cast back
+    to the activation dtype so the (bytes-bound) gather stays bf16 in bf16 runs.
+    """
+    h = (h_ext / env.out_norm[:, None]).astype(h_ext.dtype)
+    s = env_agg_sum(env, h)
+    return _linear(p, (s / env.in_norm[:, None]).astype(h_ext.dtype))
 
 
 def _sage_layer(p, h_dst, h_ext, env: GraphEnv):
     """linear1(self) + linear2(sum(nbrs)/in_deg) (module/layer.py:79-92)."""
-    ah = agg_mean(h_ext, env.src, env.dst, env.n_dst, env.in_norm, env.edge_chunk)
+    ah = (env_agg_sum(env, h_ext) / env.in_norm[:, None]).astype(h_ext.dtype)
     return _linear(p["linear1"], h_dst) + _linear(p["linear2"], ah)
 
 
@@ -292,8 +309,7 @@ def apply_model(params, state, spec: ModelSpec, feat, env: GraphEnv):
                     h = _gcn_layer(p, h_ext, env)
                 elif (not env.training) and spec.use_pp and i == 0:
                     # eval pp layer 0: cat(feat, mean) @ W  (module/layer.py:99-100)
-                    ah = agg_mean(h_ext, env.src, env.dst, env.n_dst, env.in_norm,
-                                  env.edge_chunk)
+                    ah = env_agg_sum(env, h_ext) / env.in_norm[:, None]
                     h = _linear(p, jnp.concatenate([h[:env.n_dst], ah], 1))
                 else:
                     h = _sage_layer(p, h[:env.n_dst], h_ext, env)

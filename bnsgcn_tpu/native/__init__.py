@@ -1,0 +1,88 @@
+"""ctypes bindings for the native C++ partitioner (build-on-demand).
+
+The shared library is compiled from partitioner.cpp on first use (make, then
+a direct g++ fallback) and cached next to the source. If no C++ toolchain is
+available, `native_partition` returns None and callers fall back to the
+pure-Python partitioner (data/partitioner.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libbnspartition.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _build() -> bool:
+    src = os.path.join(_DIR, "partitioner.cpp")
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return True
+    for cmd in (["make", "-C", _DIR],
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                 "-o", _SO, src]):
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+            if r.returncode == 0 and os.path.exists(_SO):
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def _load():
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not _build():
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.bns_partition.restype = ctypes.c_int
+        lib.bns_partition.argtypes = [
+            ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        lib.bns_edge_cut.restype = ctypes.c_int64
+        lib.bns_edge_cut.argtypes = [
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_partition(g, n_parts: int, obj: str = "vol", seed: int = 0,
+                     refine_passes: int = 8) -> Optional[np.ndarray]:
+    """LDG streaming + FM-lite refinement partition; None if lib unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(g.src, dtype=np.int64)
+    dst = np.ascontiguousarray(g.dst, dtype=np.int64)
+    out = np.empty(g.n_nodes, dtype=np.int32)
+    rc = lib.bns_partition(g.n_nodes, src.shape[0], src, dst,
+                           np.int32(n_parts), np.int32(1 if obj == "cut" else 0),
+                           np.uint64(seed), np.int32(refine_passes), out)
+    if rc != 0:
+        return None
+    return out

@@ -1,0 +1,199 @@
+// Native graph partitioner — the framework's METIS replacement.
+//
+// The reference delegates partitioning to METIS via
+// dgl.distributed.partition_graph (reference helper/utils.py:94-95) with
+// objtype 'vol' (communication volume) or 'cut' (edge cut). This is a
+// self-contained C++ equivalent built around the same goals:
+//
+//   1. greedy streaming assignment in BFS order (LDG-style: maximize
+//      neighbors already in the part, discounted by part fill) — gives
+//      locality-coherent balanced parts;
+//   2. FM-lite boundary refinement: several passes over boundary vertices,
+//      moving a vertex to the neighboring part with the best objective gain
+//      subject to a balance cap. For 'cut' the gain is the edge-cut delta;
+//      for 'vol' it is the delta in the number of (vertex, remote-part)
+//      adjacency pairs — the payload of one full-rate halo exchange, i.e.
+//      exactly what BNS compresses.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this toolchain).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Csr {
+  std::vector<int64_t> indptr;
+  std::vector<int64_t> adj;
+};
+
+// Undirected CSR over the union of both edge directions, self-loops dropped.
+Csr build_csr(int64_t n, int64_t m, const int64_t* src, const int64_t* dst) {
+  std::vector<int64_t> deg(n, 0);
+  for (int64_t e = 0; e < m; ++e) {
+    if (src[e] == dst[e]) continue;
+    ++deg[src[e]];
+    ++deg[dst[e]];
+  }
+  Csr g;
+  g.indptr.assign(n + 1, 0);
+  for (int64_t v = 0; v < n; ++v) g.indptr[v + 1] = g.indptr[v] + deg[v];
+  g.adj.resize(g.indptr[n]);
+  std::vector<int64_t> fill(g.indptr.begin(), g.indptr.end() - 1);
+  for (int64_t e = 0; e < m; ++e) {
+    if (src[e] == dst[e]) continue;
+    g.adj[fill[src[e]]++] = dst[e];
+    g.adj[fill[dst[e]]++] = src[e];
+  }
+  return g;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. out_part must hold n_nodes int32.
+int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
+                  const int64_t* dst, int32_t n_parts, int32_t objective,
+                  uint64_t seed, int32_t refine_passes, int32_t* out_part) {
+  if (n_parts <= 0 || n_nodes <= 0) return 1;
+  if (n_parts == 1) {
+    std::memset(out_part, 0, sizeof(int32_t) * n_nodes);
+    return 0;
+  }
+  Csr g = build_csr(n_nodes, n_edges, src, dst);
+  std::mt19937_64 rng(seed);
+
+  const int64_t cap = (n_nodes + n_parts - 1) / n_parts;      // hard balance cap
+  std::vector<int32_t> part(n_nodes, -1);
+  std::vector<int64_t> size(n_parts, 0);
+
+  // ---- phase 1: BFS-ordered LDG streaming assignment ----
+  std::vector<int64_t> order(n_nodes);
+  for (int64_t v = 0; v < n_nodes; ++v) order[v] = v;
+  std::shuffle(order.begin(), order.end(), rng);
+
+  std::vector<int64_t> nbr_count(n_parts, 0);
+  std::vector<int64_t> touched;
+  std::queue<int64_t> bfs;
+  int64_t cursor = 0;
+  std::vector<uint8_t> queued(n_nodes, 0);
+
+  auto assign = [&](int64_t v) {
+    // score: neighbors already in p, discounted by fill (LDG)
+    touched.clear();
+    for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+      int32_t p = part[g.adj[i]];
+      if (p >= 0) {
+        if (nbr_count[p] == 0) touched.push_back(p);
+        ++nbr_count[p];
+      }
+    }
+    double best_score = -1.0;
+    int32_t best_p = -1;
+    for (int32_t p : touched) {
+      if (size[p] >= cap) continue;
+      double score =
+          static_cast<double>(nbr_count[p]) * (1.0 - static_cast<double>(size[p]) / cap);
+      if (score > best_score) { best_score = score; best_p = p; }
+    }
+    if (best_p < 0) {
+      // no assignable neighbor part: least-filled part
+      int64_t min_sz = INT64_MAX;
+      for (int32_t p = 0; p < n_parts; ++p)
+        if (size[p] < min_sz) { min_sz = size[p]; best_p = p; }
+    }
+    for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+      int32_t p = part[g.adj[i]];
+      if (p >= 0) nbr_count[p] = 0;
+    }
+    part[v] = best_p;
+    ++size[best_p];
+    for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+      int64_t u = g.adj[i];
+      if (part[u] < 0 && !queued[u]) { queued[u] = 1; bfs.push(u); }
+    }
+  };
+
+  int64_t assigned = 0;
+  while (assigned < n_nodes) {
+    if (bfs.empty()) {
+      while (cursor < n_nodes && part[order[cursor]] >= 0) ++cursor;
+      if (cursor >= n_nodes) break;
+      queued[order[cursor]] = 1;
+      bfs.push(order[cursor]);
+    }
+    int64_t v = bfs.front();
+    bfs.pop();
+    if (part[v] >= 0) continue;
+    assign(v);
+    ++assigned;
+  }
+
+  // ---- phase 2: FM-lite boundary refinement ----
+  // gain arrays reused across vertices
+  std::vector<int64_t> adj_in_part(n_parts, 0);
+  const double slack = 1.02;  // allow 2% imbalance during refinement
+  const int64_t soft_cap = static_cast<int64_t>(cap * slack);
+
+  for (int32_t pass = 0; pass < refine_passes; ++pass) {
+    int64_t moves = 0;
+    for (int64_t v = 0; v < n_nodes; ++v) {
+      int32_t pv = part[v];
+      touched.clear();
+      bool boundary = false;
+      for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+        int32_t p = part[g.adj[i]];
+        if (adj_in_part[p] == 0) touched.push_back(p);
+        ++adj_in_part[p];
+        if (p != pv) boundary = true;
+      }
+      if (boundary && size[pv] > 1) {
+        int64_t best_gain = 0;
+        int32_t best_p = -1;
+        for (int32_t p : touched) {
+          if (p == pv || size[p] >= soft_cap) continue;
+          int64_t gain;
+          if (objective == 1) {                       // cut
+            gain = adj_in_part[p] - adj_in_part[pv];
+          } else {                                    // vol
+            // moving v: v stops being a halo for p, may become one for pv;
+            // approximate with (degree-normalized) cut gain + halo terms
+            int64_t halo_now = static_cast<int64_t>(touched.size()) - 1;
+            int64_t halo_after = halo_now;            // v still borders old part?
+            if (adj_in_part[pv] > 0) halo_after = halo_now;  // borders pv after move
+            else halo_after = halo_now - 1;
+            gain = (adj_in_part[p] - adj_in_part[pv]) + (halo_now - halo_after);
+          }
+          if (gain > best_gain) { best_gain = gain; best_p = p; }
+        }
+        if (best_p >= 0) {
+          part[v] = best_p;
+          --size[pv];
+          ++size[best_p];
+          ++moves;
+        }
+      }
+      for (int32_t p : touched) adj_in_part[p] = 0;
+    }
+    if (moves == 0) break;
+  }
+
+  std::memcpy(out_part, part.data(), sizeof(int32_t) * n_nodes);
+  return 0;
+}
+
+// Quality metrics for tests/logging (edge cut over directed edge list).
+int64_t bns_edge_cut(int64_t n_edges, const int64_t* src, const int64_t* dst,
+                     const int32_t* part) {
+  int64_t cut = 0;
+  for (int64_t e = 0; e < n_edges; ++e)
+    if (part[src[e]] != part[dst[e]]) ++cut;
+  return cut;
+}
+
+}  // extern "C"

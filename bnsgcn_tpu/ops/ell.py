@@ -1,0 +1,243 @@
+"""Bucketed-ELLPACK sparse aggregation — the TPU-shaped SpMM.
+
+`jax.ops.segment_sum` lowers to an XLA scatter-add, which serializes on TPU
+(~120 GB/s effective on a v5e where HBM does ~800). This module reformulates
+the same aggregation (reference DGL SpMM, module/layer.py:35-37,88-90) as
+dense, scatter-free work:
+
+  * offline (numpy, per part): group destination rows by in-degree into
+    power-of-two buckets; within a bucket store src indices as a dense
+    [rows, width] ELL table padded with a dummy index;
+  * on device: per bucket, `h[idx]` (a batched row gather — fast on TPU) and
+    a dense sum over the width axis; results land via one unique-index
+    row permutation (a gather, not a scatter);
+  * backward uses a second, transposed layout (rows = source nodes, grouped
+    by out-degree) through `jax.custom_vjp`, so the gradient is the same
+    scatter-free shape: d_h[u] = sum over out-edges of g[dst].
+
+Bucket widths are powers of two, so ELL padding wastes < 2x gathers; rows
+with degree 0 (structural padding) are skipped entirely.
+
+Layouts stack across partition parts (shared bucket shapes = max over parts)
+and ride through shard_map as ordinary sharded int arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class EllSpec:
+    """Static bucket geometry (identical across parts)."""
+    widths: tuple[int, ...]            # bucket ELL widths, ascending powers of 2
+    rows: tuple[int, ...]              # padded row count per bucket
+    n_rows: int                        # output rows (n_dst for fwd, n_src_ext for bwd)
+    n_src: int                         # gatherable rows (n_src_ext for fwd, n_dst for bwd)
+
+
+def _bucketize(deg: np.ndarray, widths: Sequence[int]) -> np.ndarray:
+    """bucket index per row; deg 0 -> -1 (skipped)."""
+    b = np.full(deg.shape, -1, dtype=np.int32)
+    lo = 0
+    for k, w in enumerate(widths):
+        b[(deg > lo) & (deg <= w)] = k
+        lo = w
+    return b
+
+
+def build_ell_numpy(src: np.ndarray, dst: np.ndarray, n_rows: int, n_src: int,
+                    widths: Sequence[int] | None = None,
+                    row_pad: Sequence[int] | None = None):
+    """Build one part's ELL tables for `out[r] = sum_{e: dst_e == r} h[src_e]`.
+
+    Padded edges must already point at dst == n_rows (they are dropped).
+    Returns (spec_widths, rows_per_bucket, arrays) where arrays =
+    {idx_k: [R_k, W_k] int32 (pad = n_src), perm: [n_rows] int32}.
+    `perm[r]` = position of row r in the bucket-concatenated output, or
+    `sum(R_k)` (a trailing zero row) for degree-0 rows.
+    """
+    real = dst < n_rows
+    src, dst = src[real], dst[real]
+    deg = np.bincount(dst, minlength=n_rows)
+    if widths is None:
+        widths = _choose_widths(deg)
+    bucket = _bucketize(deg, widths)
+
+    order = np.argsort(dst, kind="stable")
+    src_sorted = src[order]
+    dst_sorted = dst[order]
+    indptr = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(np.bincount(dst, minlength=n_rows), out=indptr[1:])
+
+    # fully vectorized fill: for each edge, its (bucket, row-within-bucket,
+    # slot-within-row) — no per-row python loop (matters at 100M edges)
+    rpos = np.zeros(n_rows, dtype=np.int64)
+    within = np.arange(len(dst_sorted), dtype=np.int64) - indptr[dst_sorted]
+    e_bucket = bucket[dst_sorted]
+
+    idx_arrays, rows_per_bucket = [], []
+    perm = np.zeros(n_rows, dtype=np.int32)
+    offset = 0
+    for k, w in enumerate(widths):
+        rows_k = np.nonzero(bucket == k)[0]
+        n_k = len(rows_k)
+        pad_rows = row_pad[k] if row_pad is not None else n_k
+        assert pad_rows >= n_k
+        rpos[rows_k] = np.arange(n_k)
+        idx = np.full((pad_rows * w,), n_src, dtype=np.int32)
+        sel = e_bucket == k
+        idx[rpos[dst_sorted[sel]] * w + within[sel]] = src_sorted[sel]
+        idx_arrays.append(idx.reshape(pad_rows, w))
+        perm[rows_k] = offset + np.arange(n_k, dtype=np.int32)
+        rows_per_bucket.append(pad_rows)
+        offset += pad_rows
+    perm[bucket == -1] = offset        # trailing zero row
+    return tuple(widths), tuple(rows_per_bucket), idx_arrays, perm
+
+
+@dataclass
+class EllLayouts:
+    """Stacked fwd+bwd layouts for all parts; device-shardable dict of arrays."""
+    fwd_spec: EllSpec
+    bwd_spec: EllSpec
+
+    def as_block(self, arrays: dict) -> dict:
+        return arrays
+
+
+def _choose_widths(deg: np.ndarray) -> tuple[int, ...]:
+    """Power-of-2 bucket-width ladder from 4 up to the max degree.
+
+    (An edge-mass-quantile scheme was tried and measured *slower* on a v5e
+    despite ~25% fewer padded gathers — wide low-row-count buckets hurt the
+    gather/reduce pipeline more than padding does. Keep the ladder.)
+    """
+    deg = deg[deg > 0]
+    max_deg = int(deg.max()) if deg.size else 1
+    widths, w = [], 4
+    while True:
+        widths.append(w)
+        if w >= max(max_deg, 1):
+            break
+        w *= 2
+    return tuple(widths)
+
+
+def _part_edges(src, dst, n_dst, direction):
+    """Real edges of one part, oriented for the requested layout direction."""
+    real = dst < n_dst
+    if direction == "fwd":             # rows = dst, gather = src
+        return src[real], dst[real]
+    return dst[real], src[real]        # rows = src(ext), gather = dst
+
+
+def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
+                  n_src_ext: int) -> tuple[EllSpec, EllSpec, dict]:
+    """Build stacked fwd (rows = dst) and bwd (rows = src_ext) ELL layouts.
+
+    src_all/dst_all: [P, E] artifact edge arrays. Returns (fwd_spec, bwd_spec,
+    arrays) with arrays = {'fwd_idx_k', 'bwd_idx_k', 'fwd_perm', 'bwd_perm'}
+    stacked on a leading P axis (shard on 'parts').
+    """
+    P = src_all.shape[0]
+
+    def build_all(direction):
+        n_rows = n_dst if direction == "fwd" else n_src_ext
+        n_src = n_src_ext if direction == "fwd" else n_dst
+        # global bucket widths + per-bucket row maxima across parts
+        degs = []
+        for p in range(P):
+            _, d = _part_edges(src_all[p], dst_all[p], n_dst, direction)
+            degs.append(np.bincount(d, minlength=n_rows))
+        widths = _choose_widths(np.concatenate(degs))
+        rows_max = [0] * len(widths)
+        for d in degs:
+            b = _bucketize(d, widths)
+            for k in range(len(widths)):
+                rows_max[k] = max(rows_max[k], int(np.sum(b == k)))
+        # lane-friendly row padding
+        rows_max = tuple(((r + 7) // 8) * 8 if r else 0 for r in rows_max)
+
+        idx_stacked = [[] for _ in widths]
+        perms = []
+        for p in range(P):
+            s, d = _part_edges(src_all[p], dst_all[p], n_dst, direction)
+            _, _, idx, perm = build_ell_numpy(s, d, n_rows, n_src,
+                                              widths=widths, row_pad=rows_max)
+            for k in range(len(widths)):
+                idx_stacked[k].append(idx[k])
+            perms.append(perm)
+        spec = EllSpec(widths=widths, rows=rows_max, n_rows=n_rows, n_src=n_src)
+        return spec, [np.stack(x) for x in idx_stacked], np.stack(perms)
+
+    fwd_spec, fwd_idx, fwd_perm = build_all("fwd")
+    bwd_spec, bwd_idx, bwd_perm = build_all("bwd")
+    arrays = {"fwd_perm": fwd_perm, "bwd_perm": bwd_perm}
+    for k in range(len(fwd_spec.widths)):
+        arrays[f"fwd_idx_{k}"] = fwd_idx[k]
+    for k in range(len(bwd_spec.widths)):
+        arrays[f"bwd_idx_{k}"] = bwd_idx[k]
+    return fwd_spec, bwd_spec, arrays
+
+
+def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000):
+    """sum over ELL width for one bucket, row-chunked so the gathered
+    [rows, w, H] intermediate never exceeds ~chunk_gathers * H elements."""
+    r = idx.shape[0]
+    h_dim = hp.shape[1]
+    rows_per_chunk = max(1, chunk_gathers // max(w, 1))
+    if r <= rows_per_chunk:
+        g = hp[idx.reshape(-1)].reshape(r, w, h_dim)
+        return g.sum(axis=1)
+    n_chunks = -(-r // rows_per_chunk)
+    pad = n_chunks * rows_per_chunk - r
+    idx_p = jnp.pad(idx, ((0, pad), (0, 0)), constant_values=hp.shape[0] - 1)
+    idx_c = idx_p.reshape(n_chunks, rows_per_chunk, w)
+
+    def body(_, ix):
+        g = hp[ix.reshape(-1)].reshape(rows_per_chunk, w, h_dim)
+        return None, g.sum(axis=1)
+
+    _, out = jax.lax.scan(body, None, idx_c)
+    return out.reshape(n_chunks * rows_per_chunk, h_dim)[:r]
+
+
+def _ell_apply(spec: EllSpec, idx_list, perm, h):
+    """Scatter-free aggregation: bucketed gather+sum, then one permutation gather."""
+    hp = jnp.concatenate([h, jnp.zeros((1, h.shape[1]), h.dtype)], 0)  # pad row
+    outs = []
+    for k, w in enumerate(spec.widths):
+        outs.append(_bucket_sum(hp, idx_list[k], w))
+    outs.append(jnp.zeros((1, h.shape[1]), h.dtype))  # degree-0 row target
+    table = jnp.concatenate(outs, axis=0)
+    return table[perm]
+
+
+def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
+                  n_buckets_bwd: int):
+    """Returns spmm(arrays, h_ext) -> [n_dst, H] with a custom VJP that runs
+    the transposed layout (also scatter-free) on the backward pass."""
+
+    @jax.custom_vjp
+    def spmm(arrays, h_ext):
+        idx = [arrays[f"fwd_idx_{k}"] for k in range(n_buckets_fwd)]
+        return _ell_apply(fwd_spec, idx, arrays["fwd_perm"], h_ext)
+
+    def fwd(arrays, h_ext):
+        return spmm(arrays, h_ext), (arrays,)
+
+    def bwd(res, g):
+        (arrays,) = res
+        idx = [arrays[f"bwd_idx_{k}"] for k in range(n_buckets_bwd)]
+        d_h = _ell_apply(bwd_spec, idx, arrays["bwd_perm"], g)
+        return None, d_h
+
+    spmm.defvjp(fwd, bwd)
+    return spmm

@@ -105,6 +105,9 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
     np_dtype = np.float32  # norms/feat host dtype; bf16 cast happens on device
     blk_np = build_block_arrays(art, spec.model, dtype=np_dtype)
+    blk_np.update(fns.extra_blk)        # ELL SpMM layouts, if enabled
+    for k in fns.drop_blk_keys:         # COO unused under ELL: save the HBM
+        blk_np.pop(k, None)
     blk = place_blocks(blk_np, mesh)
     if cfg.dtype == "bfloat16":
         blk["feat"] = blk["feat"].astype(jnp.bfloat16)
@@ -126,7 +129,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     seed = cfg.seed
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     params, state, opt_state = init_training(cfg, spec, mesh, seed=seed, dtype=dtype)
-    start_epoch, best_acc = 0, 0.0
+    start_epoch, best_acc, best_params = 0, 0.0, None
     if cfg.resume:
         latest = ckpt.latest_checkpoint(cfg)
         if latest:
@@ -140,6 +143,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             start_epoch = int(payload["epoch"]) + 1
             best_acc = float(payload["best_acc"])
             log(f"Resumed from {latest} at epoch {start_epoch}")
+            # recover the best-so-far params (final.ckpt) so a resumed run that
+            # never beats the old best still saves/evaluates a best model
+            fpath = ckpt.final_path(cfg)
+            if best_acc > 0 and os.path.exists(fpath):
+                fp = ckpt.load_checkpoint(fpath)
+                best_params = ckpt.restore_into(fp, jax.device_get(params))[0]
+            elif best_acc > 0:
+                best_acc = 0.0      # no best params recoverable: restart tracking
 
     # Both keys derive from cfg.seed: every process of a multi-host run MUST
     # agree on the sampling key or the shared-PRNG BNS exchange desyncs
@@ -156,7 +167,6 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     timer = EpochTimer(warmup=5)
     pool = ThreadPoolExecutor(max_workers=1)     # async eval (train.py:370,437-441)
     pending = None
-    best_params = None
     comm_t = 0.0
     res = RunResult()
     # widths of the per-layer exchanges: hidden-wide for layers >= 1, and a

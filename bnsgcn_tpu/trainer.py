@@ -33,7 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bnsgcn_tpu.config import Config
 from bnsgcn_tpu.data.artifacts import PartitionArtifacts
 from bnsgcn_tpu.models.gnn import GraphEnv, ModelSpec, apply_model, init_params
-from bnsgcn_tpu.ops.spmm import agg_mean, agg_sum
+from bnsgcn_tpu.ops.spmm import agg_sum
 from bnsgcn_tpu.parallel.halo import (HaloSpec, full_rate_spec, halo_apply,
                                       make_halo_plan, make_halo_spec,
                                       precompute_exchange)
@@ -45,14 +45,14 @@ from bnsgcn_tpu.parallel.mesh import make_parts_mesh, parts_sharding, replicated
 # ----------------------------------------------------------------------------
 
 def ce_sum(logits, labels, mask):
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
     return -jnp.sum(jnp.where(mask, ll, 0.0))
 
 
 def bce_sum(logits, labels, mask):
     """BCEWithLogits summed over train rows x classes (yelp multi-label)."""
-    per = optax.sigmoid_binary_cross_entropy(logits, labels)
+    per = optax.sigmoid_binary_cross_entropy(logits.astype(jnp.float32), labels)
     return jnp.sum(jnp.where(mask[:, None], per, 0.0))
 
 
@@ -100,18 +100,21 @@ class StepFns:
     forward: Callable         # (params, state, epoch, blk, tables, keys) -> logits [P, pad_inner, C]
     precompute: Callable      # (blk, tables_full) -> new feat [P, pad_inner, F'] (or gat cache)
     exchange_only: Callable   # comm-isolating microbench for Comm(s) reporting
+    extra_blk: dict           # extra per-part arrays (ELL layouts) to merge into the block dict
+    drop_blk_keys: tuple      # block keys the compiled step does not read (drop to save HBM)
 
 
 def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
-               rng, edge_chunk: int, training: bool) -> GraphEnv:
+               rng, edge_chunk: int, training: bool, aggregate=None) -> GraphEnv:
     return GraphEnv(
-        src=blk["src"], dst=blk["dst"], n_dst=hspec.pad_inner,
+        src=blk.get("src"), dst=blk.get("dst"), n_dst=hspec.pad_inner,
         in_norm=blk["in_norm"], out_norm=blk["out_norm"],
         exchange=lambda i, h: (halo_apply(hspec, plan, h), plan.presence),
         gat_feat0=((blk["feat0_ext"], plan.presence)
                    if spec.model == "gat" and "feat0_ext" in blk else None),
         training=training, rng=rng, edge_chunk=edge_chunk,
         axis_name=hspec.axis_name, inner_mask=blk["inner_mask"],
+        aggregate=aggregate,
     )
 
 
@@ -127,7 +130,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                    mesh: Mesh, rate: Optional[float] = None
                    ) -> tuple[StepFns, HaloSpec, dict, dict]:
     """Returns (fns, hspec, tables, tables_full); the tables dicts must be
-    passed (replicated) to every call."""
+    passed (replicated) to every call. When cfg.spmm == 'ell', merge
+    fns.extra_blk into the build_block_arrays dict before place_blocks
+    (run.run_training does this automatically)."""
     rate = cfg.sampling_rate if rate is None else rate
     hspec, tables = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, rate)
     hspec_full, tables_full = full_rate_spec(art.n_b, art.pad_inner, art.pad_boundary)
@@ -137,12 +142,29 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     blk_spec = P("parts")
     rep = P()
 
+    # scatter-free ELL SpMM layouts (GCN/SAGE aggregation path)
+    ell_spmm, ell_keys, ell_arrays = None, (), {}
+    if cfg.spmm == "ell" and spec.model in ("gcn", "graphsage"):
+        from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
+        fwd_spec, bwd_spec, ell_arrays = build_layouts(
+            art.src, art.dst, art.pad_inner, art.n_ext)
+        ell_spmm = make_ell_spmm(fwd_spec, bwd_spec,
+                                 len(fwd_spec.widths), len(bwd_spec.widths))
+        ell_keys = tuple(ell_arrays.keys())
+
+    def _aggregate_for(blk):
+        if ell_spmm is None:
+            return None
+        arrays = {k: blk[k] for k in ell_keys}
+        return lambda h_ext: ell_spmm(arrays, h_ext)
+
     def local_loss(params, state, blk, tables, epoch, sample_key, drop_key):
         blk = {k: v[0] for k, v in blk.items()}
         plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
         me = jax.lax.axis_index(axis)
         rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
-        env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True)
+        env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
+                         aggregate=_aggregate_for(blk))
         logits, new_state = apply_model(params, state, spec, blk["feat"], env)
         if multilabel:
             ls = bce_sum(logits, blk["label"], blk["train_mask"])
@@ -176,7 +198,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         rng = None
         if drop_key is not None:
             rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
-        env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True)
+        env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
+                         aggregate=_aggregate_for(blk))
         logits, _ = apply_model(params, state, spec, blk["feat"], env)
         return logits[None]
 
@@ -192,17 +215,16 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     def local_precompute(blk, tables_full):
         blk = {k: v[0] for k, v in blk.items()}
+        agg = _aggregate_for(blk) or (lambda h: agg_sum(
+            h, blk["src"], blk["dst"], hspec.pad_inner, cfg.edge_chunk))
         feat_ext = precompute_exchange(hspec_full, tables_full, blk["bnd"], blk["feat"])
         if spec.model == "gcn":
             # (Σ feat_u / sqrt(out_deg_u)) / sqrt(in_deg_v)  (train.py:190-199)
-            h = feat_ext / blk["out_norm"][:, None]
-            s = agg_sum(h, blk["src"], blk["dst"], hspec.pad_inner, cfg.edge_chunk)
-            out = s / blk["in_norm"][:, None]
+            out = agg(feat_ext / blk["out_norm"][:, None]) / blk["in_norm"][:, None]
         elif spec.model == "graphsage":
             # concat[feat, mean_nbr]  (train.py:200-207); note reference uses
             # fn.mean over the constructed graph == sum / global in_deg here
-            ah = agg_mean(feat_ext, blk["src"], blk["dst"], hspec.pad_inner,
-                          blk["in_norm"], cfg.edge_chunk)
+            ah = agg(feat_ext) / blk["in_norm"][:, None]
             out = jnp.concatenate([blk["feat"], ah], axis=1)
         elif spec.model == "gat":
             out = feat_ext                                   # cached raw halo feats
@@ -232,7 +254,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     fns = StepFns(train_step=train_step, forward=forward,
                   precompute=precompute, exchange_only=jax.jit(
-                      exchange_only, static_argnames="width"))
+                      exchange_only, static_argnames="width"),
+                  extra_blk=ell_arrays,
+                  drop_blk_keys=(("src", "dst") if ell_spmm is not None else ()))
     return fns, hspec, tables, tables_full
 
 

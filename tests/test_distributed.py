@@ -28,7 +28,9 @@ def _setup(g, n_parts, cfg, spec, mesh, rate=None):
     pid = partition_graph(g, n_parts, method="random", seed=3)
     art = build_artifacts(g, pid)
     fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh, rate=rate)
-    blk = place_blocks(build_block_arrays(art, spec.model), mesh)
+    blk_np = build_block_arrays(art, spec.model)
+    blk_np.update(fns.extra_blk)
+    blk = place_blocks(blk_np, mesh)
     tables = place_replicated(tables, mesh)
     tables_full = place_replicated(tables_full, mesh)
     if spec.use_pp:
