@@ -70,8 +70,11 @@ def periodic_path(cfg, epoch: int) -> str:
 
 
 def final_path(cfg) -> str:
+    """Rate-qualified (unlike the reference's {graph_name}_final.pth.tar,
+    train.py:452) so best models of different sampling-rate runs of the same
+    graph never collide — resume recovery depends on this."""
     name = cfg.graph_name or cfg.derive_graph_name()
-    return os.path.join(cfg.ckpt_path, f"{name}_final.ckpt")
+    return os.path.join(cfg.ckpt_path, f"{name}_p{cfg.sampling_rate:.2f}_final.ckpt")
 
 
 def latest_checkpoint(cfg) -> Optional[str]:

@@ -65,6 +65,7 @@ class Config:
     edge_chunk: int = 0                 # >0: aggregate edges in chunks of this size (bounds HBM)
     spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'segment'
     use_pallas: bool = False            # use Pallas aggregation kernels where available
+    profile_dir: str = ""               # write a jax.profiler trace of a few epochs here
 
     # fields injected from partition meta.json at load time
     # (reference helper/utils.py:134-138)
@@ -134,6 +135,7 @@ def create_parser() -> argparse.ArgumentParser:
     # TPU-specific
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--spmm", type=str, default="ell", choices=["ell", "segment"])
+    both("profile-dir", type=str, default="")
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)
     both("ckpt-path", type=str, default="./checkpoint/")

@@ -74,7 +74,7 @@ def build_ell_numpy(src: np.ndarray, dst: np.ndarray, n_rows: int, n_src: int,
     src_sorted = src[order]
     dst_sorted = dst[order]
     indptr = np.zeros(n_rows + 1, dtype=np.int64)
-    np.cumsum(np.bincount(dst, minlength=n_rows), out=indptr[1:])
+    np.cumsum(deg, out=indptr[1:])
 
     # fully vectorized fill: for each edge, its (bucket, row-within-bucket,
     # slot-within-row) — no per-row python loop (matters at 100M edges)
@@ -100,16 +100,6 @@ def build_ell_numpy(src: np.ndarray, dst: np.ndarray, n_rows: int, n_src: int,
         offset += pad_rows
     perm[bucket == -1] = offset        # trailing zero row
     return tuple(widths), tuple(rows_per_bucket), idx_arrays, perm
-
-
-@dataclass
-class EllLayouts:
-    """Stacked fwd+bwd layouts for all parts; device-shardable dict of arrays."""
-    fwd_spec: EllSpec
-    bwd_spec: EllSpec
-
-    def as_block(self, arrays: dict) -> dict:
-        return arrays
 
 
 def _choose_widths(deg: np.ndarray) -> tuple[int, ...]:
@@ -187,15 +177,30 @@ def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
     return fwd_spec, bwd_spec, arrays
 
 
-def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000):
+def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
+                use_pallas: bool = False):
     """sum over ELL width for one bucket, row-chunked so the gathered
-    [rows, w, H] intermediate never exceeds ~chunk_gathers * H elements."""
+    [rows, w, H] intermediate never exceeds ~chunk_gathers * H elements.
+
+    use_pallas routes the width reduction through the standard-pipeline
+    Pallas kernel (ops/pallas_spmm.pallas_bucket_reduce)."""
     r = idx.shape[0]
     h_dim = hp.shape[1]
     rows_per_chunk = max(1, chunk_gathers // max(w, 1))
-    if r <= rows_per_chunk:
-        g = hp[idx.reshape(-1)].reshape(r, w, h_dim)
+    # Pallas path: on-TPU only (off-TPU falls back to the jnp reduce — Mosaic
+    # doesn't lower there and the interpreter doesn't compose with shard_map's
+    # vma checks), and only for widths whose (8, W, H) block fits VMEM.
+    pallas_ok = (use_pallas and w <= 1024
+                 and jax.default_backend() == "tpu")
+
+    def reduce_tile(g):
+        if pallas_ok and g.shape[0] > 0 and g.shape[0] % 8 == 0:
+            from bnsgcn_tpu.ops.pallas_spmm import pallas_bucket_reduce
+            return pallas_bucket_reduce(g)
         return g.sum(axis=1)
+
+    if r <= rows_per_chunk:
+        return reduce_tile(hp[idx.reshape(-1)].reshape(r, w, h_dim))
     n_chunks = -(-r // rows_per_chunk)
     pad = n_chunks * rows_per_chunk - r
     idx_p = jnp.pad(idx, ((0, pad), (0, 0)), constant_values=hp.shape[0] - 1)
@@ -203,32 +208,32 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000):
 
     def body(_, ix):
         g = hp[ix.reshape(-1)].reshape(rows_per_chunk, w, h_dim)
-        return None, g.sum(axis=1)
+        return None, reduce_tile(g)
 
     _, out = jax.lax.scan(body, None, idx_c)
     return out.reshape(n_chunks * rows_per_chunk, h_dim)[:r]
 
 
-def _ell_apply(spec: EllSpec, idx_list, perm, h):
+def _ell_apply(spec: EllSpec, idx_list, perm, h, use_pallas: bool = False):
     """Scatter-free aggregation: bucketed gather+sum, then one permutation gather."""
     hp = jnp.concatenate([h, jnp.zeros((1, h.shape[1]), h.dtype)], 0)  # pad row
     outs = []
     for k, w in enumerate(spec.widths):
-        outs.append(_bucket_sum(hp, idx_list[k], w))
+        outs.append(_bucket_sum(hp, idx_list[k], w, use_pallas=use_pallas))
     outs.append(jnp.zeros((1, h.shape[1]), h.dtype))  # degree-0 row target
     table = jnp.concatenate(outs, axis=0)
     return table[perm]
 
 
 def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
-                  n_buckets_bwd: int):
+                  n_buckets_bwd: int, use_pallas: bool = False):
     """Returns spmm(arrays, h_ext) -> [n_dst, H] with a custom VJP that runs
     the transposed layout (also scatter-free) on the backward pass."""
 
     @jax.custom_vjp
     def spmm(arrays, h_ext):
         idx = [arrays[f"fwd_idx_{k}"] for k in range(n_buckets_fwd)]
-        return _ell_apply(fwd_spec, idx, arrays["fwd_perm"], h_ext)
+        return _ell_apply(fwd_spec, idx, arrays["fwd_perm"], h_ext, use_pallas)
 
     def fwd(arrays, h_ext):
         return spmm(arrays, h_ext), (arrays,)
@@ -236,7 +241,7 @@ def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
     def bwd(res, g):
         (arrays,) = res
         idx = [arrays[f"bwd_idx_{k}"] for k in range(n_buckets_bwd)]
-        d_h = _ell_apply(bwd_spec, idx, arrays["bwd_perm"], g)
+        d_h = _ell_apply(bwd_spec, idx, arrays["bwd_perm"], g, use_pallas)
         return None, d_h
 
     spmm.defvjp(fwd, bwd)

@@ -1,3 +1,4 @@
 from bnsgcn_tpu.parallel.sampling import pair_key, pair_sample
 from bnsgcn_tpu.parallel.halo import HaloSpec, make_halo_plan, halo_apply, sampled_presence
 from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+from bnsgcn_tpu.parallel.reducer import psum_gradients, assert_replicated

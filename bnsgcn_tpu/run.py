@@ -143,14 +143,19 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             start_epoch = int(payload["epoch"]) + 1
             best_acc = float(payload["best_acc"])
             log(f"Resumed from {latest} at epoch {start_epoch}")
-            # recover the best-so-far params (final.ckpt) so a resumed run that
-            # never beats the old best still saves/evaluates a best model
+            # recover the best-so-far params (final ckpt) so a resumed run that
+            # never beats the old best still saves/evaluates a best model; the
+            # final ckpt must carry the same best_acc or it belongs to another
+            # run — then restart best tracking instead of adopting foreign params
             fpath = ckpt.final_path(cfg)
+            recovered = False
             if best_acc > 0 and os.path.exists(fpath):
                 fp = ckpt.load_checkpoint(fpath)
-                best_params = ckpt.restore_into(fp, jax.device_get(params))[0]
-            elif best_acc > 0:
-                best_acc = 0.0      # no best params recoverable: restart tracking
+                if abs(float(fp.get("best_acc", -1.0)) - best_acc) < 1e-9:
+                    best_params = ckpt.restore_into(fp, jax.device_get(params))[0]
+                    recovered = True
+            if best_acc > 0 and not recovered:
+                best_acc = 0.0      # no matching best params: restart tracking
 
     # Both keys derive from cfg.seed: every process of a multi-host run MUST
     # agree on the sampling key or the shared-PRNG BNS exchange desyncs
@@ -180,14 +185,28 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         fns.exchange_only(blk, tables, jnp.uint32(0), sample_key,
                           width=w).block_until_ready()
 
+    # profiler window (SURVEY §5.1 upgrade: the reference's wall-clock comm
+    # spans are meaningless under XLA; named traces are the TPU equivalent),
+    # clamped into the epochs this run actually executes
+    prof_start = max(timer.warmup + 1, start_epoch)
+    prof_stop = min(prof_start + 3, cfg.n_epochs - 1)
+    tracing = False
+
     loss = jnp.zeros(())
     for epoch in range(start_epoch, cfg.n_epochs):
+        if cfg.profile_dir and epoch == prof_start and prof_stop > prof_start:
+            jax.profiler.start_trace(cfg.profile_dir)
+            tracing = True
         t0 = time.perf_counter()
         params, state, opt_state, loss = fns.train_step(
             params, state, opt_state, jnp.uint32(epoch), blk, tables,
             sample_key, drop_key)
         loss.block_until_ready()
         dt = time.perf_counter() - t0
+        if tracing and epoch >= prof_stop:
+            jax.profiler.stop_trace()
+            tracing = False
+            log(f"profiler trace written to {cfg.profile_dir}")
 
         if epoch == timer.warmup or (epoch + 1) % cfg.log_every == 0:
             # comm microbench: exchange-only programs at each real layer width,
@@ -227,6 +246,9 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                     lambda p=p_host, s=s_host: (p, evaluate_trans(
                         "Epoch %05d" % epoch, p, s, spec, val_g, result_file)[0]))
 
+    if tracing:
+        jax.profiler.stop_trace()
+        log(f"profiler trace written to {cfg.profile_dir}")
     if pending is not None:
         p_eval, acc = pending.result()
         if acc > best_acc:

@@ -149,7 +149,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         fwd_spec, bwd_spec, ell_arrays = build_layouts(
             art.src, art.dst, art.pad_inner, art.n_ext)
         ell_spmm = make_ell_spmm(fwd_spec, bwd_spec,
-                                 len(fwd_spec.widths), len(bwd_spec.widths))
+                                 len(fwd_spec.widths), len(bwd_spec.widths),
+                                 use_pallas=cfg.use_pallas)
         ell_keys = tuple(ell_arrays.keys())
 
     def _aggregate_for(blk):

@@ -1,0 +1,62 @@
+"""Checkpoint round-trip, resume state, and reducer utilities."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu.config import Config
+from bnsgcn_tpu.models.gnn import ModelSpec, init_params
+from bnsgcn_tpu.trainer import make_tx
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    spec = ModelSpec("graphsage", (5, 8, 3), norm="batch", dropout=0.1,
+                     train_size=10)
+    params, state = init_params(jax.random.key(0), spec)
+    tx = make_tx(Config(lr=0.01, weight_decay=1e-4))
+    opt = tx.init(params)
+    path = str(tmp_path / "a.ckpt")
+    ckpt.save_checkpoint(path, params=params, opt_state=opt, bn_state=state,
+                         epoch=17, best_acc=0.93, seed=5)
+    payload = ckpt.load_checkpoint(path)
+    assert payload["epoch"] == 17 and abs(payload["best_acc"] - 0.93) < 1e-9
+    p2, o2, s2 = ckpt.restore_into(payload, params, opt, state)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                 params, p2)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                 opt, o2)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                 state, s2)
+
+
+def test_latest_checkpoint_selection(tmp_path):
+    cfg = Config(dataset="sbm", n_partitions=2, sampling_rate=0.5,
+                 ckpt_path=str(tmp_path), graph_name="g")
+    spec = ModelSpec("gcn", (4, 4, 2), norm=None)
+    params, _ = init_params(jax.random.key(0), spec)
+    for ep in (9, 19, 4):
+        ckpt.save_checkpoint(ckpt.periodic_path(cfg, ep), params=params, epoch=ep)
+    latest = ckpt.latest_checkpoint(cfg)
+    assert latest and latest.endswith("_19.ckpt")
+    # different rate -> no match
+    assert ckpt.latest_checkpoint(cfg.replace(sampling_rate=0.1)) is None
+
+
+def test_atomic_write_no_tmp_left(tmp_path):
+    spec = ModelSpec("gcn", (4, 4, 2), norm=None)
+    params, _ = init_params(jax.random.key(0), spec)
+    path = str(tmp_path / "x.ckpt")
+    ckpt.save_checkpoint(path, params=params)
+    assert os.path.exists(path) and not os.path.exists(path + ".tmp")
+
+
+def test_assert_replicated_passes_on_replicated():
+    from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+    from bnsgcn_tpu.parallel.reducer import assert_replicated
+    from bnsgcn_tpu.trainer import place_replicated
+    mesh = make_parts_mesh(4)
+    tree = place_replicated({"w": jnp.ones((8, 8))}, mesh)
+    assert_replicated(tree)
