@@ -139,9 +139,12 @@ def main():
         times.append(time.perf_counter() - t0)
     epoch_t = float(np.mean(times))
     eps = g.n_edges / epoch_t
+    from bnsgcn_tpu.utils.timers import estimate_static_hbm
     log(f"epoch time mean={epoch_t:.4f}s min={np.min(times):.4f}s "
         f"({eps / 1e6:.1f}M edges/s/chip; baseline {BASELINE_EPOCH_S}s/rank) "
-        f"loss={float(loss):.4f}")
+        f"loss={float(loss):.4f} "
+        f"static HBM ~{estimate_static_hbm([blk], [params, opt, state]):.0f} MB "
+        f"(reference peak: 2087 MB)")
 
     print(json.dumps({
         "metric": "reddit_rank_share_epoch_time_per_chip",

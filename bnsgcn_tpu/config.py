@@ -66,6 +66,8 @@ class Config:
     spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'segment'
     use_pallas: bool = False            # use Pallas aggregation kernels where available
     profile_dir: str = ""               # write a jax.profiler trace of a few epochs here
+    eval_device: str = "host"           # 'host' (background thread, full graph) |
+                                        # 'mesh' (distributed full-rate eval on the parts mesh)
 
     # fields injected from partition meta.json at load time
     # (reference helper/utils.py:134-138)
@@ -136,6 +138,7 @@ def create_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--spmm", type=str, default="ell", choices=["ell", "segment"])
     both("profile-dir", type=str, default="")
+    both("eval-device", type=str, default="host", choices=["host", "mesh"])
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)
     both("ckpt-path", type=str, default="./checkpoint/")

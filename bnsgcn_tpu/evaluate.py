@@ -81,6 +81,47 @@ def evaluate_induc(name: str, params, state, spec: ModelSpec, g: Graph,
     return acc
 
 
+def gather_parts(art, stacked) -> np.ndarray:
+    """[P, pad_inner, ...] stacked per-part rows -> [N, ...] in global node
+    order (drops padding via inner_mask, places via global_nid)."""
+    stacked = np.asarray(stacked)
+    out = np.zeros((int(art.n_inner.sum()),) + stacked.shape[2:], stacked.dtype)
+    for p in range(art.n_parts):
+        ids = art.global_nid[p][art.inner_mask[p]]
+        out[ids] = stacked[p][art.inner_mask[p]]
+    return out
+
+
+# back-compat alias used by tests/benchmarks
+def gather_part_logits(art, logits) -> np.ndarray:
+    return gather_parts(art, logits)
+
+
+def evaluate_mesh(name: str, eval_forward, params, state, blk_eval, tables_full,
+                  art_eval, modes: tuple[str, ...],
+                  result_file: Optional[str] = None) -> dict[str, float]:
+    """Mesh-distributed evaluation: full-rate eval forward over the parts
+    mesh, metrics on host. `modes` from {'val','test'}; returns accuracies.
+    Capability upgrade over the reference's single-process CPU eval
+    (train.py:313-319,427-441). Single-host only: the gathered logits span
+    the whole mesh (run.py gates --eval-device mesh when n_nodes > 1)."""
+    logits = gather_parts(art_eval, eval_forward(params, state, blk_eval,
+                                                 tables_full))
+    labels = gather_parts(art_eval, art_eval.label)
+    masks = {"val": art_eval.val_mask, "test": art_eval.test_mask}
+    accs = {}
+    for mode in modes:
+        m = gather_parts(art_eval, masks[mode])
+        accs[mode] = calc_acc(logits[m], labels[m])
+    if "test" in accs and "val" in accs:
+        buf = "{:s} | Validation Accuracy {:.2%} | Test Accuracy {:.2%}".format(
+            name, accs["val"], accs["test"])
+    else:
+        buf = "{:s} | Accuracy {:.2%}".format(name, list(accs.values())[0])
+    _emit(buf, result_file)
+    return accs
+
+
 def _emit(buf: str, result_file: Optional[str]):
     print(buf)
     if result_file is not None:

@@ -325,7 +325,10 @@ def apply_model(params, state, spec: ModelSpec, feat, env: GraphEnv):
                         h_ext, presence = env.exchange(i, h)
                         h_d = h
                 else:
-                    h_ext, presence, h_d = h, None, h
+                    # eval: exchange is the identity on a single device and a
+                    # full-rate halo exchange under mesh-distributed eval
+                    h_ext, presence = env.exchange(i, h)
+                    h_d = h
                 h = _gat_layer(p, h_d, h_ext, presence, env, spec.heads, out_feats,
                                rngs[i], spec.dropout, env.training)
                 h = h.mean(1)                             # mean over heads (module/model.py:124)

@@ -33,12 +33,12 @@ from bnsgcn_tpu.data.artifacts import (PartitionArtifacts, build_artifacts,
 from bnsgcn_tpu.data.datasets import inductive_split, load_data
 from bnsgcn_tpu.data.graph import Graph
 from bnsgcn_tpu.data.partitioner import partition_graph
-from bnsgcn_tpu.evaluate import evaluate_induc, evaluate_trans
+from bnsgcn_tpu.evaluate import evaluate_induc, evaluate_mesh, evaluate_trans
 from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
 from bnsgcn_tpu.parallel.mesh import make_parts_mesh
 from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns, init_training,
                                 place_blocks, place_replicated)
-from bnsgcn_tpu.utils.timers import EpochTimer, format_memory_stats
+from bnsgcn_tpu.utils.timers import EpochTimer, estimate_static_hbm, format_memory_stats
 
 
 def artifacts_dir(cfg: Config) -> str:
@@ -124,6 +124,37 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     log(f"Mesh: {cfg.n_partitions} parts | pad_inner={art.pad_inner} "
         f"pad_boundary={art.pad_boundary} pad_send={hspec.pad_send} "
         f"edges/part={art.pad_edges}")
+
+    # ---- mesh-distributed eval resources (--eval-device mesh) ----
+    mesh_eval = cfg.eval and cfg.eval_device == "mesh"
+    if mesh_eval and cfg.n_nodes > 1:
+        raise NotImplementedError(
+            "--eval-device mesh is single-host for now: the gathered eval "
+            "logits span the whole mesh (needs a process_allgather); use "
+            "--eval-device host on multi-host runs")
+    eval_val = None                    # (fns, blk, tables_full_d, art)
+
+    def _eval_resources(graph, name_suffix):
+        if not cfg.inductive:
+            # same graph as training: share every placed training array and
+            # swap only 'feat' for the raw (non-precomputed, f32) features
+            b = dict(blk)
+            b["feat"] = jax.device_put(
+                jnp.asarray(build_block_arrays(art, spec.model)["feat"]),
+                blk["inner_mask"].sharding)
+            return fns, b, tables_full_d, art
+        base = cfg.graph_name or cfg.derive_graph_name()
+        cfg_e = cfg.replace(graph_name=base + name_suffix)
+        art_e = prepare_partition(cfg_e, graph)
+        fns_e, _, _, tf = build_step_fns(cfg, spec, art_e, mesh)
+        b = build_block_arrays(art_e, spec.model)
+        b.update(fns_e.extra_blk)
+        for k in fns_e.drop_blk_keys:
+            b.pop(k, None)
+        return fns_e, place_blocks(b, mesh), place_replicated(tf, mesh), art_e
+
+    if mesh_eval:
+        eval_val = _eval_resources(val_g, "-val")
 
     # ---- model / optimizer init, optionally resumed ----
     seed = cfg.seed
@@ -230,7 +261,15 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             ckpt.save_checkpoint(ckpt.periodic_path(cfg, epoch),
                                  params=params, opt_state=opt_state, bn_state=state,
                                  epoch=epoch, best_acc=best_acc, seed=seed)
-        if cfg.eval and (epoch + 1) % cfg.log_every == 0:
+        if mesh_eval and (epoch + 1) % cfg.log_every == 0:
+            fns_e, blk_e, tf_e, art_e = eval_val
+            modes = ("val",) if cfg.inductive else ("val", "test")
+            accs = evaluate_mesh("Epoch %05d" % epoch, fns_e.eval_forward,
+                                 params, state, blk_e, tf_e, art_e, modes,
+                                 result_file)
+            if accs["val"] > best_acc:
+                best_acc, best_params = accs["val"], jax.device_get(params)
+        elif cfg.eval and (epoch + 1) % cfg.log_every == 0:
             if pending is not None:
                 p_eval, acc = pending.result()
                 if acc > best_acc:
@@ -259,6 +298,13 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     res.final_loss = float(loss)
     res.memory = format_memory_stats()
     log(res.memory)
+    # transductive mesh eval shares the training blocks (only 'feat' is new);
+    # inductive keeps a separate val-graph block set resident
+    hbm_parts = [blk]
+    if mesh_eval:
+        hbm_parts.append(eval_val[1] if cfg.inductive else eval_val[1]["feat"])
+    log("static HBM/device ~{:.1f} MB (blocks + params + opt)".format(
+        estimate_static_hbm(hbm_parts, [params, opt_state, state], cfg.n_partitions)))
 
     if cfg.eval and best_params is not None:
         ckpt.save_checkpoint(ckpt.final_path(cfg), params=best_params,
@@ -267,6 +313,17 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         log("model saved")
         log("Max Validation Accuracy {:.2%}".format(best_acc))
         res.best_val_acc = best_acc
-        res.test_acc = evaluate_induc("Test Result", best_params,
-                                      jax.device_get(state), spec, test_g, "test")
+        if mesh_eval:
+            # test resources built lazily (inductive test graph = full graph;
+            # no reason to pin it in HBM during training)
+            fns_e, blk_e, tf_e, art_e = (
+                _eval_resources(test_g, "-test") if cfg.inductive else eval_val)
+            pb = place_replicated(best_params, mesh)
+            res.test_acc = evaluate_mesh("Test Result", fns_e.eval_forward,
+                                         pb, state, blk_e, tf_e, art_e,
+                                         ("test",))["test"]
+        else:
+            res.test_acc = evaluate_induc("Test Result", best_params,
+                                          jax.device_get(state), spec, test_g,
+                                          "test")
     return res

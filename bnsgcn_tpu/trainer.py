@@ -102,6 +102,7 @@ class StepFns:
     exchange_only: Callable   # comm-isolating microbench for Comm(s) reporting
     extra_blk: dict           # extra per-part arrays (ELL layouts) to merge into the block dict
     drop_blk_keys: tuple      # block keys the compiled step does not read (drop to save HBM)
+    eval_forward: Callable = None  # mesh-distributed eval-mode forward (full rate)
 
 
 def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
@@ -214,6 +215,28 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             out_specs=blk_spec)
         return f(params, state, blk, tables, epoch, sample_key, drop_key)
 
+    def local_eval(params, state, blk, tables_full):
+        """Mesh-distributed full-rate eval forward (capability upgrade over
+        the reference's single-process CPU eval, train.py:313-319,427-441).
+        Eval-path semantics: no dropout, all halos present, BN running stats;
+        the caller supplies eval-graph artifacts so norms are the eval
+        graph's own degrees (module/layer.py:39-45,93-102)."""
+        blk = {k: v[0] for k, v in blk.items()}
+        zero = jnp.zeros((), jnp.uint32)
+        plan = make_halo_plan(hspec_full, tables_full, blk["bnd"], zero,
+                              jax.random.key(0))
+        env = _local_env(spec, hspec_full, blk, plan, None, cfg.edge_chunk,
+                         False, aggregate=_aggregate_for(blk))
+        logits, _ = apply_model(params, state, spec, blk["feat"], env)
+        return logits[None]
+
+    @jax.jit
+    def eval_forward(params, state, blk, tables_full):
+        f = jax.shard_map(local_eval, mesh=mesh,
+                          in_specs=(rep, rep, blk_spec, rep),
+                          out_specs=blk_spec)
+        return f(params, state, blk, tables_full)
+
     def local_precompute(blk, tables_full):
         blk = {k: v[0] for k, v in blk.items()}
         agg = _aggregate_for(blk) or (lambda h: agg_sum(
@@ -256,6 +279,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     fns = StepFns(train_step=train_step, forward=forward,
                   precompute=precompute, exchange_only=jax.jit(
                       exchange_only, static_argnames="width"),
+                  eval_forward=eval_forward,
                   extra_blk=ell_arrays,
                   drop_blk_keys=(("src", "dst") if ell_spmm is not None else ()))
     return fns, hspec, tables, tables_full

@@ -88,3 +88,25 @@ def format_memory_stats() -> str:
             f"peak {s['peak_bytes_in_use'] / 2**20:.2f} MB, "
             f"limit {s['bytes_limit'] / 2**20:.2f} MB")
     return "\n".join(lines) if lines else "(no device memory stats available)"
+
+
+def estimate_static_hbm(per_part_trees, replicated_trees=(),
+                        n_parts: int = 1) -> float:
+    """Static per-device HBM estimate in MB: one part's slice of the sharded
+    arrays plus every replicated tree. Used where the runtime can't report
+    peak memory (some PJRT transports return None from memory_stats); real
+    peak adds the transient activations on top."""
+    import jax
+
+    def nbytes(tree):
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            if hasattr(leaf, "nbytes"):
+                total += int(leaf.nbytes)
+            elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+                total += int(leaf.size) * leaf.dtype.itemsize
+        return total
+
+    per_part = sum(nbytes(t) for t in per_part_trees) / max(n_parts, 1)
+    repl = sum(nbytes(t) for t in replicated_trees)
+    return (per_part + repl) / 2**20
