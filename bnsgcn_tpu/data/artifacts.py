@@ -213,13 +213,18 @@ def save_artifacts(art: PartitionArtifacts, path: str):
                             **{k: getattr(art, k)[p] for k in _PER_PART})
 
 
-def load_artifacts(path: str) -> PartitionArtifacts:
+def load_artifacts(path: str, parts: "list[int] | None" = None) -> PartitionArtifacts:
+    """Load partition artifacts. `parts` restricts the per-part arrays to the
+    listed part ids — the multi-host flow where each process reads only the
+    parts whose mesh slots it hosts (reference per-rank disk read,
+    helper/utils.py:101-140, under --skip-partition). The stacked axis then
+    has len(parts) rows in the given order; n_parts and meta stay global."""
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     shared = np.load(os.path.join(path, "shared.npz"))
-    parts = [np.load(os.path.join(path, f"part{p}.npz"))
-             for p in range(meta["n_parts"])]
-    stacked = {k: np.stack([pt[k] for pt in parts]) for k in _PER_PART}
+    part_ids = list(range(meta["n_parts"])) if parts is None else list(parts)
+    loaded = [np.load(os.path.join(path, f"part{p}.npz")) for p in part_ids]
+    stacked = {k: np.stack([pt[k] for pt in loaded]) for k in _PER_PART}
     return PartitionArtifacts(
         n_parts=meta["n_parts"], pad_inner=meta["pad_inner"],
         pad_boundary=meta["pad_boundary"], pad_edges=meta["pad_edges"],

@@ -37,7 +37,8 @@ from bnsgcn_tpu.evaluate import evaluate_induc, evaluate_mesh, evaluate_trans
 from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
 from bnsgcn_tpu.parallel.mesh import make_parts_mesh
 from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns, init_training,
-                                place_blocks, place_replicated)
+                                local_part_ids, place_blocks, place_blocks_local,
+                                place_replicated)
 from bnsgcn_tpu.utils.timers import EpochTimer, estimate_static_hbm, format_memory_stats
 
 
@@ -82,25 +83,50 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                  devices=None, verbose: bool = True) -> RunResult:
     log = print if verbose else (lambda *a, **k: None)
 
+    multi_host = jax.process_count() > 1
+    is_rank0 = jax.process_index() == 0
+
     # ---- data + eval graphs (train.py:313-319) ----
+    # multi-host: only rank 0 ever needs the full undistributed graph (host
+    # eval); the other ranks read just their partition artifacts
     val_g = test_g = None
-    if g is None and (cfg.eval or art is None):
+    need_graph_eval = cfg.eval and (is_rank0 or not multi_host)
+    need_graph_partition = art is None and not (multi_host or cfg.skip_partition)
+    if g is None and (need_graph_eval or need_graph_partition):
         g, _, _ = load_data(cfg)
-    if cfg.eval:
+    if cfg.eval and g is not None:
         if cfg.inductive:
             _, val_g, test_g = inductive_split(g)
         else:
             val_g = test_g = g
     train_g = g.subgraph(g.train_mask) if (cfg.inductive and g is not None) else g
 
-    # ---- partition artifacts ----
+    # ---- mesh + partition artifacts ----
+    mesh = make_parts_mesh(cfg.n_partitions, devices)
     if art is None:
-        art = prepare_partition(cfg, train_g) if not cfg.skip_partition \
-            else load_artifacts(artifacts_dir(cfg))
+        if multi_host:
+            # each process loads only the parts whose mesh slots it hosts
+            # (main.py already partitioned on rank 0 behind a barrier); the
+            # ELL layout builder needs the global degree view, so multi-host
+            # uses the segment SpMM for now
+            if cfg.spmm == "ell":
+                log("multi-host: falling back to --spmm segment "
+                    "(ELL layout build needs a global degree view)")
+                cfg = cfg.replace(spmm="segment")
+            mine = local_part_ids(mesh)
+            if not mine:
+                raise ValueError(
+                    f"process {jax.process_index()} hosts no partition: use "
+                    f"n_partitions >= {jax.process_count()} x local device "
+                    f"count (mesh takes the first n_partitions global devices)")
+            art = load_artifacts(artifacts_dir(cfg), parts=mine)
+        elif cfg.skip_partition:
+            art = load_artifacts(artifacts_dir(cfg))
+        else:
+            art = prepare_partition(cfg, train_g)
     cfg = cfg.replace(n_feat=art.n_feat, n_class=art.n_class, n_train=art.n_train)
 
-    # ---- mesh + step functions ----
-    mesh = make_parts_mesh(cfg.n_partitions, devices)
+    # ---- step functions + device data ----
     spec = spec_from_config(cfg)
     fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
     np_dtype = np.float32  # norms/feat host dtype; bf16 cast happens on device
@@ -108,7 +134,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     blk_np.update(fns.extra_blk)        # ELL SpMM layouts, if enabled
     for k in fns.drop_blk_keys:         # COO unused under ELL: save the HBM
         blk_np.pop(k, None)
-    blk = place_blocks(blk_np, mesh)
+    blk = place_blocks_local(blk_np, mesh) if multi_host else place_blocks(blk_np, mesh)
     if cfg.dtype == "bfloat16":
         blk["feat"] = blk["feat"].astype(jnp.bfloat16)
     tables = place_replicated(tables, mesh)
@@ -127,7 +153,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
     mesh_eval = cfg.eval and cfg.eval_device == "mesh"
-    if mesh_eval and cfg.n_nodes > 1:
+    if mesh_eval and multi_host:
         raise NotImplementedError(
             "--eval-device mesh is single-host for now: the gathered eval "
             "logits span the whole mesh (needs a process_allgather); use "
@@ -161,7 +187,32 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     params, state, opt_state = init_training(cfg, spec, mesh, seed=seed, dtype=dtype)
     start_epoch, best_acc, best_params = 0, 0.0, None
-    if cfg.resume:
+    if cfg.resume and multi_host:
+        # rank 0 reads the checkpoint; everything restored must be broadcast
+        # so all processes drive the SPMD loop over the same epoch range
+        from jax.experimental import multihost_utils
+        payload = None
+        if is_rank0:
+            latest = ckpt.latest_checkpoint(cfg)
+            if latest:
+                payload = ckpt.load_checkpoint(latest)
+        have = multihost_utils.broadcast_one_to_all(
+            np.int64(0 if payload is None else int(payload["epoch"]) + 1))
+        if int(have) > 0:
+            host = ckpt.restore_into(payload, jax.device_get(params),
+                                     jax.device_get(opt_state),
+                                     jax.device_get(state)) if is_rank0 else (
+                jax.device_get(params), jax.device_get(opt_state),
+                jax.device_get(state))
+            host = multihost_utils.broadcast_one_to_all(host)
+            params = place_replicated(host[0], mesh)
+            opt_state = place_replicated(host[1], mesh)
+            state = place_replicated(host[2], mesh)
+            start_epoch = int(have)
+            best_acc = float(multihost_utils.broadcast_one_to_all(np.float64(
+                payload["best_acc"] if payload else 0.0)))
+            log(f"Resumed (broadcast from rank 0) at epoch {start_epoch}")
+    elif cfg.resume:
         latest = ckpt.latest_checkpoint(cfg)
         if latest:
             payload = ckpt.load_checkpoint(latest)
@@ -256,8 +307,9 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             log("Process 000 | Epoch {:05d} | Time(s) {:.4f} | Comm(s) {:.4f} | "
                 "Reduce(s) {:.4f} | Loss {:.4f}".format(epoch, mt, mc, mr, float(loss)))
 
-        if (epoch + 1) % cfg.log_every == 0:
-            # periodic checkpoint regardless of eval, so --no-eval runs resume too
+        if (epoch + 1) % cfg.log_every == 0 and is_rank0:
+            # periodic checkpoint regardless of eval, so --no-eval runs resume
+            # too; rank 0 only (reference train.py:427-428)
             ckpt.save_checkpoint(ckpt.periodic_path(cfg, epoch),
                                  params=params, opt_state=opt_state, bn_state=state,
                                  epoch=epoch, best_acc=best_acc, seed=seed)
@@ -269,7 +321,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                                  result_file)
             if accs["val"] > best_acc:
                 best_acc, best_params = accs["val"], jax.device_get(params)
-        elif cfg.eval and (epoch + 1) % cfg.log_every == 0:
+        elif cfg.eval and is_rank0 and (epoch + 1) % cfg.log_every == 0:
             if pending is not None:
                 p_eval, acc = pending.result()
                 if acc > best_acc:
@@ -306,7 +358,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     log("static HBM/device ~{:.1f} MB (blocks + params + opt)".format(
         estimate_static_hbm(hbm_parts, [params, opt_state, state], cfg.n_partitions)))
 
-    if cfg.eval and best_params is not None:
+    if cfg.eval and best_params is not None and is_rank0:
         ckpt.save_checkpoint(ckpt.final_path(cfg), params=best_params,
                              bn_state=jax.device_get(state),
                              epoch=cfg.n_epochs - 1, best_acc=best_acc, seed=seed)

@@ -87,7 +87,36 @@ def place_blocks(blk: dict, mesh: Mesh) -> dict:
 
 def place_replicated(tree, mesh: Mesh):
     sh = replicated_sharding(mesh)
+    if jax.process_count() > 1:
+        # multi-host: every process contributes its full copy
+        return jax.tree.map(
+            lambda v: jax.make_array_from_process_local_data(sh, np.asarray(v)),
+            tree)
     return jax.tree.map(lambda v: jax.device_put(jnp.asarray(v), sh), tree)
+
+
+def local_part_ids(mesh: Mesh) -> list[int]:
+    """Mesh slots (== partition ids) hosted by this process, in mesh order.
+    The multi-host analog of the reference's rank -> partition mapping
+    (main.py:42-48)."""
+    me = jax.process_index()
+    return [p for p, d in enumerate(mesh.devices.flat) if d.process_index == me]
+
+
+def place_blocks_local(blk_local: dict, mesh: Mesh) -> dict:
+    """Build globally-sharded block arrays from process-local rows.
+
+    `blk_local` arrays carry only this process's parts on the leading axis
+    (rows in `local_part_ids(mesh)` order, from
+    `load_artifacts(..., parts=local_part_ids(mesh))`)."""
+    sh = parts_sharding(mesh)
+    n_global = len(mesh.devices.flat)
+    out = {}
+    for k, v in blk_local.items():
+        v = np.asarray(v)
+        out[k] = jax.make_array_from_process_local_data(
+            sh, v, (n_global,) + v.shape[1:])
+    return out
 
 
 # ----------------------------------------------------------------------------

@@ -131,3 +131,30 @@ def test_single_partition_degenerate(g):
     assert art.n_b.sum() == 0
     real = art.dst[0] < art.pad_inner
     assert int(real.sum()) == g.n_edges
+
+
+def test_load_artifacts_partial_parts(tmp_path, g):
+    pid, art = _artifacts(g, P=4)
+    save_artifacts(art, str(tmp_path / "pp"))
+    sub = load_artifacts(str(tmp_path / "pp"), parts=[2, 0])
+    assert sub.n_parts == 4                       # meta stays global
+    np.testing.assert_array_equal(sub.feat[0], art.feat[2])
+    np.testing.assert_array_equal(sub.feat[1], art.feat[0])
+    np.testing.assert_array_equal(sub.bnd[0], art.bnd[2])
+    np.testing.assert_array_equal(sub.n_b, art.n_b)
+
+
+def test_place_blocks_local_single_host_equivalent(g):
+    import jax
+    from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+    from bnsgcn_tpu.trainer import (local_part_ids, place_blocks,
+                                    place_blocks_local)
+    pid, art = _artifacts(g, P=4)
+    mesh = make_parts_mesh(4)
+    assert local_part_ids(mesh) == [0, 1, 2, 3]   # single process hosts all
+    blk = {"feat": art.feat, "bnd": art.bnd}
+    a = place_blocks(blk, mesh)
+    b = place_blocks_local(blk, mesh)
+    for k in blk:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+        assert a[k].sharding == b[k].sharding
