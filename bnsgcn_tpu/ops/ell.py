@@ -33,6 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 
+ELL_SPLIT_CAP = 128   # rows with degree > cap are split into cap-wide chunks
+
+
 @dataclass(frozen=True)
 class EllSpec:
     """Static bucket geometry (identical across parts)."""
@@ -40,6 +43,8 @@ class EllSpec:
     rows: tuple[int, ...]              # padded row count per bucket
     n_rows: int                        # output rows (n_dst for fwd, n_src_ext for bwd)
     n_src: int                         # gatherable rows (n_src_ext for fwd, n_dst for bwd)
+    n_split: int = 0                   # padded count of split (degree > cap) rows
+    n_chunks: int = 0                  # padded count of their cap-wide chunks
 
 
 def _bucketize(deg: np.ndarray, widths: Sequence[int]) -> np.ndarray:
@@ -54,21 +59,37 @@ def _bucketize(deg: np.ndarray, widths: Sequence[int]) -> np.ndarray:
 
 def build_ell_numpy(src: np.ndarray, dst: np.ndarray, n_rows: int, n_src: int,
                     widths: Sequence[int] | None = None,
-                    row_pad: Sequence[int] | None = None):
+                    row_pad: Sequence[int] | None = None,
+                    cap: int | None = None,
+                    split_pad: int = 0, chunk_pad: int = 0):
     """Build one part's ELL tables for `out[r] = sum_{e: dst_e == r} h[src_e]`.
 
     Padded edges must already point at dst == n_rows (they are dropped).
-    Returns (spec_widths, rows_per_bucket, arrays) where arrays =
-    {idx_k: [R_k, W_k] int32 (pad = n_src), perm: [n_rows] int32}.
-    `perm[r]` = position of row r in the bucket-concatenated output, or
-    `sum(R_k)` (a trailing zero row) for degree-0 rows.
+    Returns (widths, rows_per_bucket, idx_arrays, perm, chunk_pos, chunk_seg).
+
+    Split-row scheme (`cap`): rows with degree > cap become ceil(deg/cap)
+    cap-wide pseudo-rows appended to the cap bucket (cutting the power-law
+    padding waste from ~1.5x to ~1.15x of E); their partial sums are combined
+    by a tiny sorted segment-sum over `chunk_pos`/`chunk_seg`. Table layout:
+    [bucket rows 0..T-1 ; combine results T..T+split_pad-1 ; zero row].
+    `perm[r]` points a normal row at its bucket position, a split row at its
+    combine slot, and a degree-0 row at the zero row.
     """
+    if cap is not None and (cap < 4 or cap & (cap - 1)):
+        raise ValueError(f"split cap must be a power of two >= 4, got {cap}")
     real = dst < n_rows
     src, dst = src[real], dst[real]
     deg = np.bincount(dst, minlength=n_rows)
+    split_mask = (deg > cap) if cap else np.zeros(n_rows, dtype=bool)
+    deg_b = np.where(split_mask, 0, deg)
     if widths is None:
-        widths = _choose_widths(deg)
-    bucket = _bucketize(deg, widths)
+        # ladder from the FULL degree distribution so it reaches cap whenever
+        # any row splits (deg_b alone would stop short of cap)
+        widths = _choose_widths(deg, cap=cap)
+    if cap and split_mask.any() and widths[-1] != cap:
+        raise ValueError(f"width ladder {widths} must end at cap={cap} "
+                         f"when split rows exist")
+    bucket = _bucketize(deg_b, widths)
 
     order = np.argsort(dst, kind="stable")
     src_sorted = src[order]
@@ -76,41 +97,76 @@ def build_ell_numpy(src: np.ndarray, dst: np.ndarray, n_rows: int, n_src: int,
     indptr = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(deg, out=indptr[1:])
 
+    # split bookkeeping: pseudo-row base per split row, chunk segments
+    split_rows = np.nonzero(split_mask)[0]
+    n_split = len(split_rows)
+    chunks_per = np.ceil(deg[split_rows] / cap).astype(np.int64) if n_split else         np.zeros(0, np.int64)
+    n_pseudo = int(chunks_per.sum())
+    assert n_split <= max(split_pad, 0) or split_pad == 0
+    pseudo_base = np.zeros(n_rows, dtype=np.int64)
+    if n_split:
+        pseudo_base[split_rows] = np.concatenate([[0], np.cumsum(chunks_per)[:-1]])
+
     # fully vectorized fill: for each edge, its (bucket, row-within-bucket,
     # slot-within-row) — no per-row python loop (matters at 100M edges)
     rpos = np.zeros(n_rows, dtype=np.int64)
     within = np.arange(len(dst_sorted), dtype=np.int64) - indptr[dst_sorted]
     e_bucket = bucket[dst_sorted]
+    e_split = split_mask[dst_sorted]
 
     idx_arrays, rows_per_bucket = [], []
     perm = np.zeros(n_rows, dtype=np.int32)
     offset = 0
+    cap_k = len(widths) - 1
     for k, w in enumerate(widths):
         rows_k = np.nonzero(bucket == k)[0]
         n_k = len(rows_k)
-        pad_rows = row_pad[k] if row_pad is not None else n_k
-        assert pad_rows >= n_k
+        extra = n_pseudo if (cap and k == cap_k) else 0
+        pad_rows = row_pad[k] if row_pad is not None else n_k + extra
+        assert pad_rows >= n_k + extra
         rpos[rows_k] = np.arange(n_k)
         idx = np.full((pad_rows * w,), n_src, dtype=np.int32)
-        sel = e_bucket == k
+        sel = (e_bucket == k) & ~e_split
         idx[rpos[dst_sorted[sel]] * w + within[sel]] = src_sorted[sel]
+        if extra:
+            sel = e_split
+            pr = n_k + pseudo_base[dst_sorted[sel]] + within[sel] // cap
+            idx[pr * w + within[sel] % cap] = src_sorted[sel]
+            cap_offset, cap_normal = offset, n_k
         idx_arrays.append(idx.reshape(pad_rows, w))
         perm[rows_k] = offset + np.arange(n_k, dtype=np.int32)
         rows_per_bucket.append(pad_rows)
         offset += pad_rows
-    perm[bucket == -1] = offset        # trailing zero row
-    return tuple(widths), tuple(rows_per_bucket), idx_arrays, perm
+    total = offset                                 # table rows T
+
+    sp = split_pad if split_pad else ((n_split + 7) // 8 * 8 if n_split else 0)
+    cp = chunk_pad if chunk_pad else ((n_pseudo + 7) // 8 * 8 if n_pseudo else 0)
+    # chunk_pos indexes the CAP BUCKET's rows (plus one appended zero row at
+    # rows_per_bucket[-1]) — not the whole table — so the combine gathers from
+    # the cap bucket output directly without re-materializing the table
+    cap_rows = rows_per_bucket[-1] if rows_per_bucket else 0
+    chunk_pos = np.full(cp, cap_rows, dtype=np.int32)   # pad -> appended zero row
+    chunk_seg = np.full(cp, sp, dtype=np.int32)         # pad -> dropped segment
+    if n_split:
+        chunk_pos[:n_pseudo] = cap_normal + np.arange(n_pseudo)
+        chunk_seg[:n_pseudo] = np.repeat(np.arange(n_split), chunks_per)
+        perm[split_rows] = total + np.arange(n_split, dtype=np.int32)
+    perm[(bucket == -1) & ~split_mask] = total + sp     # zero row
+    return tuple(widths), tuple(rows_per_bucket), idx_arrays, perm, chunk_pos, chunk_seg
 
 
-def _choose_widths(deg: np.ndarray) -> tuple[int, ...]:
-    """Power-of-2 bucket-width ladder from 4 up to the max degree.
+def _choose_widths(deg: np.ndarray, cap: int | None = None) -> tuple[int, ...]:
+    """Power-of-2 bucket-width ladder from 4 up to min(max degree, cap).
 
     (An edge-mass-quantile scheme was tried and measured *slower* on a v5e
     despite ~25% fewer padded gathers — wide low-row-count buckets hurt the
-    gather/reduce pipeline more than padding does. Keep the ladder.)
+    gather/reduce pipeline more than padding does. Keep the ladder; the
+    split-row cap handles the power-law tail instead.)
     """
     deg = deg[deg > 0]
     max_deg = int(deg.max()) if deg.size else 1
+    if cap:
+        max_deg = min(max_deg, cap)
     widths, w = [], 4
     while True:
         widths.append(w)
@@ -129,47 +185,70 @@ def _part_edges(src, dst, n_dst, direction):
 
 
 def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
-                  n_src_ext: int) -> tuple[EllSpec, EllSpec, dict]:
+                  n_src_ext: int, cap: int = ELL_SPLIT_CAP
+                  ) -> tuple[EllSpec, EllSpec, dict]:
     """Build stacked fwd (rows = dst) and bwd (rows = src_ext) ELL layouts.
 
     src_all/dst_all: [P, E] artifact edge arrays. Returns (fwd_spec, bwd_spec,
-    arrays) with arrays = {'fwd_idx_k', 'bwd_idx_k', 'fwd_perm', 'bwd_perm'}
-    stacked on a leading P axis (shard on 'parts').
+    arrays) with arrays = {'{dir}_idx_k', '{dir}_perm', '{dir}_chunk_pos',
+    '{dir}_chunk_seg'} stacked on a leading P axis (shard on 'parts').
     """
     P = src_all.shape[0]
 
     def build_all(direction):
         n_rows = n_dst if direction == "fwd" else n_src_ext
         n_src = n_src_ext if direction == "fwd" else n_dst
-        # global bucket widths + per-bucket row maxima across parts
+        # global bucket widths + per-bucket row/split/chunk maxima across parts
         degs = []
         for p in range(P):
             _, d = _part_edges(src_all[p], dst_all[p], n_dst, direction)
             degs.append(np.bincount(d, minlength=n_rows))
-        widths = _choose_widths(np.concatenate(degs))
+        all_deg = np.concatenate(degs)
+        widths = _choose_widths(all_deg, cap=cap)
+        eff_cap = cap if (cap and all_deg.max() > cap) else None
         rows_max = [0] * len(widths)
+        split_max = chunk_max = 0
         for d in degs:
-            b = _bucketize(d, widths)
+            split = (d > eff_cap) if eff_cap else np.zeros_like(d, dtype=bool)
+            b = _bucketize(np.where(split, 0, d), widths)
             for k in range(len(widths)):
                 rows_max[k] = max(rows_max[k], int(np.sum(b == k)))
-        # lane-friendly row padding
-        rows_max = tuple(((r + 7) // 8) * 8 if r else 0 for r in rows_max)
+            if eff_cap:
+                n_sp = int(split.sum())
+                n_ch = int(np.ceil(d[split] / eff_cap).sum())
+                split_max = max(split_max, n_sp)
+                chunk_max = max(chunk_max, n_ch)
+        if eff_cap:
+            rows_max[-1] += chunk_max          # pseudo-rows live in the cap bucket
+        # lane-friendly padding
+        pad8 = lambda r: ((r + 7) // 8) * 8 if r else 0
+        rows_max = tuple(pad8(r) for r in rows_max)
+        split_max, chunk_max = pad8(split_max), pad8(chunk_max)
 
         idx_stacked = [[] for _ in widths]
-        perms = []
+        perms, cpos, csegs = [], [], []
         for p in range(P):
             s, d = _part_edges(src_all[p], dst_all[p], n_dst, direction)
-            _, _, idx, perm = build_ell_numpy(s, d, n_rows, n_src,
-                                              widths=widths, row_pad=rows_max)
+            _, _, idx, perm, cp, cs = build_ell_numpy(
+                s, d, n_rows, n_src, widths=widths, row_pad=rows_max,
+                cap=eff_cap, split_pad=split_max, chunk_pad=chunk_max)
             for k in range(len(widths)):
                 idx_stacked[k].append(idx[k])
             perms.append(perm)
-        spec = EllSpec(widths=widths, rows=rows_max, n_rows=n_rows, n_src=n_src)
-        return spec, [np.stack(x) for x in idx_stacked], np.stack(perms)
+            cpos.append(cp)
+            csegs.append(cs)
+        spec = EllSpec(widths=widths, rows=rows_max, n_rows=n_rows,
+                       n_src=n_src, n_split=split_max, n_chunks=chunk_max)
+        return (spec, [np.stack(x) for x in idx_stacked], np.stack(perms),
+                np.stack(cpos), np.stack(csegs))
 
-    fwd_spec, fwd_idx, fwd_perm = build_all("fwd")
-    bwd_spec, bwd_idx, bwd_perm = build_all("bwd")
+    fwd_spec, fwd_idx, fwd_perm, fwd_cp, fwd_cs = build_all("fwd")
+    bwd_spec, bwd_idx, bwd_perm, bwd_cp, bwd_cs = build_all("bwd")
     arrays = {"fwd_perm": fwd_perm, "bwd_perm": bwd_perm}
+    if fwd_spec.n_split:
+        arrays["fwd_chunk_pos"], arrays["fwd_chunk_seg"] = fwd_cp, fwd_cs
+    if bwd_spec.n_split:
+        arrays["bwd_chunk_pos"], arrays["bwd_chunk_seg"] = bwd_cp, bwd_cs
     for k in range(len(fwd_spec.widths)):
         arrays[f"fwd_idx_{k}"] = fwd_idx[k]
     for k in range(len(bwd_spec.widths)):
@@ -214,15 +293,27 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
     return out.reshape(n_chunks * rows_per_chunk, h_dim)[:r]
 
 
-def _ell_apply(spec: EllSpec, idx_list, perm, h, use_pallas: bool = False):
-    """Scatter-free aggregation: bucketed gather+sum, then one permutation gather."""
+def _ell_apply(spec: EllSpec, idx_list, perm, h, use_pallas: bool = False,
+               chunk_pos=None, chunk_seg=None):
+    """Bucketed gather+sum (+ split-row combine), then one permutation gather.
+    The only scatter is the tiny sorted segment-sum over split-row chunks."""
     hp = jnp.concatenate([h, jnp.zeros((1, h.shape[1]), h.dtype)], 0)  # pad row
+    zero = jnp.zeros((1, h.shape[1]), h.dtype)
     outs = []
     for k, w in enumerate(spec.widths):
         outs.append(_bucket_sum(hp, idx_list[k], w, use_pallas=use_pallas))
-    outs.append(jnp.zeros((1, h.shape[1]), h.dtype))  # degree-0 row target
-    table = jnp.concatenate(outs, axis=0)
-    return table[perm]
+    if spec.n_split:
+        # combine split-row chunks straight from the cap bucket's output
+        # (chunk_pos is cap-bucket-relative; its pad points at the zero row)
+        cap_z = jnp.concatenate([outs[-1], zero], axis=0)
+        gathered = cap_z[chunk_pos]                    # [n_chunks, H]
+        comb = jax.ops.segment_sum(gathered, chunk_seg,
+                                   num_segments=spec.n_split + 1,
+                                   indices_are_sorted=True)[:spec.n_split]
+        full = jnp.concatenate(outs + [comb, zero], axis=0)
+    else:
+        full = jnp.concatenate(outs + [zero], axis=0)
+    return full[perm]
 
 
 def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
@@ -233,7 +324,8 @@ def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
     @jax.custom_vjp
     def spmm(arrays, h_ext):
         idx = [arrays[f"fwd_idx_{k}"] for k in range(n_buckets_fwd)]
-        return _ell_apply(fwd_spec, idx, arrays["fwd_perm"], h_ext, use_pallas)
+        return _ell_apply(fwd_spec, idx, arrays["fwd_perm"], h_ext, use_pallas,
+                          arrays.get("fwd_chunk_pos"), arrays.get("fwd_chunk_seg"))
 
     def fwd(arrays, h_ext):
         return spmm(arrays, h_ext), (arrays,)
@@ -241,7 +333,8 @@ def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
     def bwd(res, g):
         (arrays,) = res
         idx = [arrays[f"bwd_idx_{k}"] for k in range(n_buckets_bwd)]
-        d_h = _ell_apply(bwd_spec, idx, arrays["bwd_perm"], g, use_pallas)
+        d_h = _ell_apply(bwd_spec, idx, arrays["bwd_perm"], g, use_pallas,
+                         arrays.get("bwd_chunk_pos"), arrays.get("bwd_chunk_seg"))
         return None, d_h
 
     spmm.defvjp(fwd, bwd)

@@ -103,16 +103,22 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
     # ---- mesh + partition artifacts ----
     mesh = make_parts_mesh(cfg.n_partitions, devices)
+    if multi_host and cfg.spmm == "ell":
+        # the ELL layout builder needs the global degree view
+        log("multi-host: falling back to --spmm segment")
+        cfg = cfg.replace(spmm="segment")
+    if multi_host and art is not None:
+        n_local = len(local_part_ids(mesh))
+        if art.feat.shape[0] != n_local:
+            raise ValueError(
+                f"multi-host run_training(art=...) needs artifacts holding "
+                f"only this process's {n_local} parts "
+                f"(load_artifacts(parts=local_part_ids(mesh))), got "
+                f"{art.feat.shape[0]} part rows")
     if art is None:
         if multi_host:
             # each process loads only the parts whose mesh slots it hosts
-            # (main.py already partitioned on rank 0 behind a barrier); the
-            # ELL layout builder needs the global degree view, so multi-host
-            # uses the segment SpMM for now
-            if cfg.spmm == "ell":
-                log("multi-host: falling back to --spmm segment "
-                    "(ELL layout build needs a global degree view)")
-                cfg = cfg.replace(spmm="segment")
+            # (main.py already partitioned on rank 0 behind a barrier)
             mine = local_part_ids(mesh)
             if not mine:
                 raise ValueError(
