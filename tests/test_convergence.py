@@ -1,0 +1,57 @@
+"""BNS convergence parity: sampled training (P=4, rate 0.1) reaches the same
+accuracy neighborhood as exact training (P=1, rate 1.0) — the paper's core
+claim (README.md:123-130) at test scale."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bnsgcn_tpu.config import Config
+from bnsgcn_tpu.data.artifacts import build_artifacts
+from bnsgcn_tpu.data.graph import sbm_graph
+from bnsgcn_tpu.data.partitioner import partition_graph
+from bnsgcn_tpu.evaluate import gather_parts
+from bnsgcn_tpu.models.gnn import ModelSpec, init_params
+from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns,
+                                init_training, place_blocks, place_replicated)
+from bnsgcn_tpu.utils.metrics import calc_acc
+
+
+def _train(g, P, rate, epochs=80):
+    cfg = Config(model="graphsage", dropout=0.1, use_pp=True, norm="layer",
+                 n_train=g.n_train, lr=0.01, sampling_rate=rate)
+    spec = ModelSpec("graphsage", (g.n_feat, 16, g.n_class), norm="layer",
+                     dropout=0.1, use_pp=True, train_size=g.n_train)
+    mesh = make_parts_mesh(P)
+    art = build_artifacts(g, partition_graph(g, P, method="random", seed=2))
+    fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
+    blk_np = build_block_arrays(art, "graphsage")
+    blk_np.update(fns.extra_blk)
+    for k in fns.drop_blk_keys:
+        blk_np.pop(k, None)
+    blk = place_blocks(blk_np, mesh)
+    tb = place_replicated(tables, mesh)
+    blk["feat"] = fns.precompute(blk, place_replicated(tables_full, mesh))
+    params, state = init_params(jax.random.key(5), spec)
+    params = place_replicated(params, mesh)
+    state = place_replicated(state, mesh)
+    _, _, opt = init_training(cfg, spec, mesh)
+    for e in range(epochs):
+        params, state, opt, loss = fns.train_step(
+            params, state, opt, jnp.uint32(e), blk, tb,
+            jax.random.key(0), jax.random.key(1))
+    logits = gather_parts(art, fns.forward(params, state, jnp.uint32(0), blk,
+                                           tb, jax.random.key(0)))
+    labels = gather_parts(art, art.label)
+    mask = gather_parts(art, art.val_mask)
+    return calc_acc(logits[mask], labels[mask])
+
+
+def test_bns_rate01_converges_like_exact():
+    g = sbm_graph(n_nodes=400, n_class=4, n_feat=12, p_in=0.10, p_out=0.004,
+                  seed=70)
+    acc_exact = _train(g, P=1, rate=1.0)
+    acc_bns = _train(g, P=4, rate=0.1)
+    assert acc_exact > 0.85, acc_exact
+    assert acc_bns > acc_exact - 0.08, (acc_bns, acc_exact)

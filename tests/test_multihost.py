@@ -1,0 +1,75 @@
+"""Real multi-process SPMD: two jax.distributed processes (4 CPU devices
+each) drive one 8-part mesh end-to-end — partial artifact loading,
+process-local placement, seed broadcast, shared-PRNG BNS exchange across
+hosts, and resume-broadcast. The reference's multi-node flow
+(scripts/reddit_multi_node.sh) without a cluster (SURVEY §4: 'multi-node
+without a cluster')."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch(rank, port, tmp, epochs, resume=False):
+    env = os.environ.copy()
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PYTHONPATH": REPO,
+    })
+    cmd = [sys.executable, "-m", "bnsgcn_tpu.main",
+           "--dataset", "sbm", "--n-partitions", "8", "--model", "graphsage",
+           "--n-layers", "2", "--n-hidden", "16", "--n-epochs", str(epochs),
+           "--log-every", "10", "--sampling-rate", "0.5", "--use-pp",
+           "--fix-seed", "--no-eval", "--skip-partition",
+           "--n-nodes", "2", "--node-rank", str(rank), "--port", str(port),
+           "--part-path", f"{tmp}/parts", "--ckpt-path", f"{tmp}/ckpt",
+           "--results-path", f"{tmp}/res"]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, cwd=REPO)
+
+
+def test_two_process_training_and_resume(tmp_path):
+    tmp = str(tmp_path)
+    env = os.environ.copy()
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                "PYTHONPATH": REPO})
+    subprocess.run([sys.executable, "-m", "bnsgcn_tpu.partition_cli",
+                    "--dataset", "sbm", "--n-partitions", "8", "--fix-seed",
+                    "--part-path", f"{tmp}/parts"],
+                   env=env, check=True, capture_output=True, cwd=REPO)
+
+    port = _free_port()
+    procs = [_launch(r, port, tmp, epochs=12) for r in (0, 1)]
+    outs = [p.communicate(timeout=280)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    # identical losses on both ranks == shared-PRNG BNS + replicated params hold
+    losses = [[ln for ln in o.splitlines() if "Loss" in ln][-1].split()[-1]
+              for o in outs]
+    assert losses[0] == losses[1], losses
+
+    port = _free_port()
+    procs = [_launch(r, port, tmp, epochs=20, resume=True) for r in (0, 1)]
+    outs = [p.communicate(timeout=280)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    for o in outs:
+        assert "Resumed (broadcast from rank 0) at epoch 10" in o, o[-2000:]
+    losses2 = [[ln for ln in o.splitlines() if "Loss" in ln][-1].split()[-1]
+               for o in outs]
+    assert losses2[0] == losses2[1]
+    assert float(losses2[0]) < float(losses[0])   # training continued
