@@ -62,6 +62,7 @@ class PartitionArtifacts:
     n_class: int = 0
     n_train: int = 0
     multilabel: bool = False
+    ell_geometry: "dict | None" = None   # global ELL pads (ops/ell.compute_geometry)
 
     @property
     def n_halo_slots(self) -> int:
@@ -177,6 +178,10 @@ def build_artifacts(g: Graph, part_id: np.ndarray,
         ind[p, :k] = in_deg_g[inner[p]]
         gnid[p, :k] = inner[p]
 
+    from bnsgcn_tpu.ops.ell import compute_geometry
+    n_ext_rows = pad_inner + P * pad_boundary
+    geometry = compute_geometry(src_a, dst_a, pad_inner, n_ext_rows)
+
     return PartitionArtifacts(
         n_parts=P, pad_inner=pad_inner, pad_boundary=pad_boundary,
         pad_edges=pad_edges, n_inner=n_inner, n_b=n_b,
@@ -184,7 +189,7 @@ def build_artifacts(g: Graph, part_id: np.ndarray,
         inner_mask=im, in_deg=ind, out_deg_ext=out_deg_ext,
         src=src_a, dst=dst_a, bnd=bnd, global_nid=gnid,
         n_feat=F, n_class=g.n_class, n_train=g.n_train,
-        multilabel=g.multilabel,
+        multilabel=g.multilabel, ell_geometry=geometry,
     )
 
 
@@ -198,12 +203,13 @@ def save_artifacts(art: PartitionArtifacts, path: str):
     (replaces DGL's json+tensor dirs, reference helper/utils.py:94-98)."""
     os.makedirs(path, exist_ok=True)
     meta = {
-        "format_version": 1,
+        "format_version": 2,
         "n_parts": art.n_parts, "pad_inner": art.pad_inner,
         "pad_boundary": art.pad_boundary, "pad_edges": art.pad_edges,
         "n_feat": art.n_feat, "n_class": art.n_class, "n_train": art.n_train,
         "multilabel": art.multilabel,
         "n_inner": art.n_inner.tolist(),
+        "ell_geometry": art.ell_geometry,
     }
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f, indent=2)
@@ -232,5 +238,6 @@ def load_artifacts(path: str, parts: "list[int] | None" = None) -> PartitionArti
         n_b=shared["n_b"],
         n_feat=meta["n_feat"], n_class=meta["n_class"],
         n_train=meta["n_train"], multilabel=meta["multilabel"],
+        ell_geometry=meta.get("ell_geometry"),
         **stacked,
     )

@@ -97,28 +97,67 @@ def gather_part_logits(art, logits) -> np.ndarray:
     return gather_parts(art, logits)
 
 
+def _local_part_rows(arr) -> np.ndarray:
+    """This process's rows of a parts-sharded [P, R, ...] array, in mesh order."""
+    shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(jax.device_get(s.data)) for s in shards], 0)
+
+
+def _metric_stats(logits, labels, mask, multilabel) -> np.ndarray:
+    """Sufficient statistics for accuracy / micro-F1 as a summable vector."""
+    lg, lb = logits[mask], labels[mask]
+    if multilabel:
+        pred = lg > 0
+        pos = lb.astype(bool)
+        return np.array([np.sum(pos & pred), np.sum(~pos & pred),
+                         np.sum(pos & ~pred)], dtype=np.int64)
+    correct = np.sum(np.argmax(lg, 1) == lb) if lg.size else 0
+    return np.array([correct, lb.shape[0], 0], dtype=np.int64)
+
+
+def _stats_to_acc(s, multilabel) -> float:
+    if multilabel:
+        denom = 2 * s[0] + s[1] + s[2]
+        return float(2 * s[0] / denom) if denom else 0.0
+    return float(s[0] / s[1]) if s[1] else 0.0
+
+
 def evaluate_mesh(name: str, eval_forward, params, state, blk_eval, tables_full,
                   art_eval, modes: tuple[str, ...],
                   result_file: Optional[str] = None) -> dict[str, float]:
     """Mesh-distributed evaluation: full-rate eval forward over the parts
     mesh, metrics on host. `modes` from {'val','test'}; returns accuracies.
     Capability upgrade over the reference's single-process CPU eval
-    (train.py:313-319,427-441). Single-host only: the gathered logits span
-    the whole mesh (run.py gates --eval-device mesh when n_nodes > 1)."""
-    logits = gather_parts(art_eval, eval_forward(params, state, blk_eval,
-                                                 tables_full))
-    labels = gather_parts(art_eval, art_eval.label)
+    (train.py:313-319,427-441). Multi-host: each process computes metric
+    statistics from its addressable shards; tiny allgather-sum combines them
+    (art_eval then holds only this process's part rows)."""
+    out = eval_forward(params, state, blk_eval, tables_full)
     masks = {"val": art_eval.val_mask, "test": art_eval.test_mask}
     accs = {}
-    for mode in modes:
-        m = gather_parts(art_eval, masks[mode])
-        accs[mode] = calc_acc(logits[m], labels[m])
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        logits_l = _local_part_rows(out)                  # [P_local, R, C]
+        for mode in modes:
+            s = np.zeros(3, dtype=np.int64)
+            for i in range(logits_l.shape[0]):
+                m = masks[mode][i] & art_eval.inner_mask[i]
+                s += _metric_stats(logits_l[i], art_eval.label[i], m,
+                                   art_eval.multilabel)
+            total = np.asarray(multihost_utils.process_allgather(s)).sum(0)
+            accs[mode] = _stats_to_acc(total, art_eval.multilabel)
+    else:
+        logits = gather_parts(art_eval, out)
+        labels = gather_parts(art_eval, art_eval.label)
+        for mode in modes:
+            m = gather_parts(art_eval, masks[mode])
+            accs[mode] = calc_acc(logits[m], labels[m])
     if "test" in accs and "val" in accs:
         buf = "{:s} | Validation Accuracy {:.2%} | Test Accuracy {:.2%}".format(
             name, accs["val"], accs["test"])
     else:
         buf = "{:s} | Accuracy {:.2%}".format(name, list(accs.values())[0])
-    _emit(buf, result_file)
+    if jax.process_index() == 0:
+        _emit(buf, result_file)
     return accs
 
 

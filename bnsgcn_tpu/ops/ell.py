@@ -184,21 +184,17 @@ def _part_edges(src, dst, n_dst, direction):
     return dst[real], src[real]        # rows = src(ext), gather = dst
 
 
-def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
-                  n_src_ext: int, cap: int = ELL_SPLIT_CAP
-                  ) -> tuple[EllSpec, EllSpec, dict]:
-    """Build stacked fwd (rows = dst) and bwd (rows = src_ext) ELL layouts.
-
-    src_all/dst_all: [P, E] artifact edge arrays. Returns (fwd_spec, bwd_spec,
-    arrays) with arrays = {'{dir}_idx_k', '{dir}_perm', '{dir}_chunk_pos',
-    '{dir}_chunk_seg'} stacked on a leading P axis (shard on 'parts').
-    """
+def compute_geometry(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
+                     n_src_ext: int, cap: int = ELL_SPLIT_CAP) -> dict:
+    """Global ELL geometry (widths, padded rows, split/chunk pads) for both
+    directions — a pure graph property needing the FULL set of parts.
+    JSON-serializable so the offline partitioner can store it in meta.json,
+    letting multi-host processes build their ELL tables from local parts
+    alone (data/artifacts.py)."""
     P = src_all.shape[0]
-
-    def build_all(direction):
+    geo = {}
+    for direction in ("fwd", "bwd"):
         n_rows = n_dst if direction == "fwd" else n_src_ext
-        n_src = n_src_ext if direction == "fwd" else n_dst
-        # global bucket widths + per-bucket row/split/chunk maxima across parts
         degs = []
         for p in range(P):
             _, d = _part_edges(src_all[p], dst_all[p], n_dst, direction)
@@ -214,16 +210,43 @@ def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
             for k in range(len(widths)):
                 rows_max[k] = max(rows_max[k], int(np.sum(b == k)))
             if eff_cap:
-                n_sp = int(split.sum())
-                n_ch = int(np.ceil(d[split] / eff_cap).sum())
-                split_max = max(split_max, n_sp)
-                chunk_max = max(chunk_max, n_ch)
+                split_max = max(split_max, int(split.sum()))
+                chunk_max = max(chunk_max, int(np.ceil(d[split] / eff_cap).sum()))
         if eff_cap:
             rows_max[-1] += chunk_max          # pseudo-rows live in the cap bucket
-        # lane-friendly padding
         pad8 = lambda r: ((r + 7) // 8) * 8 if r else 0
-        rows_max = tuple(pad8(r) for r in rows_max)
-        split_max, chunk_max = pad8(split_max), pad8(chunk_max)
+        geo[direction] = {
+            "widths": [int(w) for w in widths],
+            "rows": [pad8(r) for r in rows_max],
+            "split": pad8(split_max), "chunks": pad8(chunk_max),
+            "cap": eff_cap,
+        }
+    return geo
+
+
+def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
+                  n_src_ext: int, cap: int = ELL_SPLIT_CAP,
+                  geometry: dict | None = None
+                  ) -> tuple[EllSpec, EllSpec, dict]:
+    """Build stacked fwd (rows = dst) and bwd (rows = src_ext) ELL layouts.
+
+    src_all/dst_all: [P_local, E] artifact edge arrays — may be a subset of
+    parts when `geometry` (from compute_geometry, possibly via meta.json)
+    provides the global pads. Returns (fwd_spec, bwd_spec, arrays) with
+    arrays = {'{dir}_idx_k', '{dir}_perm', '{dir}_chunk_pos',
+    '{dir}_chunk_seg'} stacked on the leading local-part axis.
+    """
+    P = src_all.shape[0]
+    if geometry is None:
+        geometry = compute_geometry(src_all, dst_all, n_dst, n_src_ext, cap)
+
+    def build_all(direction):
+        n_rows = n_dst if direction == "fwd" else n_src_ext
+        n_src = n_src_ext if direction == "fwd" else n_dst
+        g = geometry[direction]
+        widths = tuple(g["widths"])
+        rows_max = tuple(g["rows"])
+        split_max, chunk_max, eff_cap = g["split"], g["chunks"], g["cap"]
 
         idx_stacked = [[] for _ in widths]
         perms, cpos, csegs = [], [], []

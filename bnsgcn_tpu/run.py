@@ -90,7 +90,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     # multi-host: only rank 0 ever needs the full undistributed graph (host
     # eval); the other ranks read just their partition artifacts
     val_g = test_g = None
-    need_graph_eval = cfg.eval and (is_rank0 or not multi_host)
+    # transductive mesh eval runs entirely from partition artifacts — the
+    # full undistributed graph is only needed for host eval / inductive splits
+    trans_mesh_eval = (cfg.eval and cfg.eval_device == "mesh"
+                       and not cfg.inductive)
+    need_graph_eval = (cfg.eval and not trans_mesh_eval
+                       and (is_rank0 or not multi_host))
     need_graph_partition = art is None and not (multi_host or cfg.skip_partition)
     if g is None and (need_graph_eval or need_graph_partition):
         g, _, _ = load_data(cfg)
@@ -103,10 +108,6 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
     # ---- mesh + partition artifacts ----
     mesh = make_parts_mesh(cfg.n_partitions, devices)
-    if multi_host and cfg.spmm == "ell":
-        # the ELL layout builder needs the global degree view
-        log("multi-host: falling back to --spmm segment")
-        cfg = cfg.replace(spmm="segment")
     if multi_host and art is not None:
         n_local = len(local_part_ids(mesh))
         if art.feat.shape[0] != n_local:
@@ -131,6 +132,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         else:
             art = prepare_partition(cfg, train_g)
     cfg = cfg.replace(n_feat=art.n_feat, n_class=art.n_class, n_train=art.n_train)
+    if multi_host and cfg.spmm == "ell" and art.ell_geometry is None:
+        # pre-v2 artifacts lack the global ELL geometry a partial load needs
+        log("multi-host: artifacts carry no ELL geometry (old format); "
+            "falling back to --spmm segment")
+        cfg = cfg.replace(spmm="segment")
 
     # ---- step functions + device data ----
     spec = spec_from_config(cfg)
@@ -159,11 +165,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
     mesh_eval = cfg.eval and cfg.eval_device == "mesh"
-    if mesh_eval and multi_host:
+    if mesh_eval and multi_host and cfg.inductive:
         raise NotImplementedError(
-            "--eval-device mesh is single-host for now: the gathered eval "
-            "logits span the whole mesh (needs a process_allgather); use "
-            "--eval-device host on multi-host runs")
+            "multi-host mesh eval is transductive-only for now (the inductive "
+            "path would need distributed partitioning of the eval subgraphs); "
+            "use --eval-device host on inductive multi-host runs")
     eval_val = None                    # (fns, blk, tables_full_d, art)
 
     def _eval_resources(graph, name_suffix):
@@ -171,9 +177,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # same graph as training: share every placed training array and
             # swap only 'feat' for the raw (non-precomputed, f32) features
             b = dict(blk)
-            b["feat"] = jax.device_put(
-                jnp.asarray(build_block_arrays(art, spec.model)["feat"]),
-                blk["inner_mask"].sharding)
+            raw = {"feat": build_block_arrays(art, spec.model)["feat"]}
+            if multi_host:
+                b["feat"] = place_blocks_local(raw, mesh)["feat"]
+            else:
+                b["feat"] = jax.device_put(jnp.asarray(raw["feat"]),
+                                           blk["inner_mask"].sharding)
             return fns, b, tables_full_d, art
         base = cfg.graph_name or cfg.derive_graph_name()
         cfg_e = cfg.replace(graph_name=base + name_suffix)
@@ -217,6 +226,24 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             start_epoch = int(have)
             best_acc = float(multihost_utils.broadcast_one_to_all(np.float64(
                 payload["best_acc"] if payload else 0.0)))
+            # recover best params (rank 0 reads the matching final ckpt, all
+            # ranks receive them — the final mesh test eval is a collective);
+            # no match -> restart best tracking, same as single-host
+            recovered = np.int64(0)
+            fp = None
+            if is_rank0 and best_acc > 0:
+                fpath = ckpt.final_path(cfg)
+                if os.path.exists(fpath):
+                    fp = ckpt.load_checkpoint(fpath)
+                    if abs(float(fp.get("best_acc", -1.0)) - best_acc) < 1e-9:
+                        recovered = np.int64(1)
+            recovered = int(multihost_utils.broadcast_one_to_all(recovered))
+            if best_acc > 0 and recovered:
+                bp = (ckpt.restore_into(fp, jax.device_get(params))[0]
+                      if is_rank0 else jax.device_get(params))
+                best_params = multihost_utils.broadcast_one_to_all(bp)
+            elif best_acc > 0:
+                best_acc = 0.0
             log(f"Resumed (broadcast from rank 0) at epoch {start_epoch}")
     elif cfg.resume:
         latest = ckpt.latest_checkpoint(cfg)
@@ -364,12 +391,16 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     log("static HBM/device ~{:.1f} MB (blocks + params + opt)".format(
         estimate_static_hbm(hbm_parts, [params, opt_state, state], cfg.n_partitions)))
 
-    if cfg.eval and best_params is not None and is_rank0:
-        ckpt.save_checkpoint(ckpt.final_path(cfg), params=best_params,
-                             bn_state=jax.device_get(state),
-                             epoch=cfg.n_epochs - 1, best_acc=best_acc, seed=seed)
-        log("model saved")
-        log("Max Validation Accuracy {:.2%}".format(best_acc))
+    if cfg.eval and best_params is not None:
+        # checkpoint/log I/O is rank-0-only, but the mesh test eval is a
+        # COLLECTIVE — every process must join it or the mesh deadlocks
+        if is_rank0:
+            ckpt.save_checkpoint(ckpt.final_path(cfg), params=best_params,
+                                 bn_state=jax.device_get(state),
+                                 epoch=cfg.n_epochs - 1, best_acc=best_acc,
+                                 seed=seed)
+            log("model saved")
+            log("Max Validation Accuracy {:.2%}".format(best_acc))
         res.best_val_acc = best_acc
         if mesh_eval:
             # test resources built lazily (inductive test graph = full graph;
@@ -380,7 +411,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             res.test_acc = evaluate_mesh("Test Result", fns_e.eval_forward,
                                          pb, state, blk_e, tf_e, art_e,
                                          ("test",))["test"]
-        else:
+        elif is_rank0:
             res.test_acc = evaluate_induc("Test Result", best_params,
                                           jax.device_get(state), spec, test_g,
                                           "test")

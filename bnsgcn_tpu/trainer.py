@@ -177,7 +177,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     if cfg.spmm == "ell" and spec.model in ("gcn", "graphsage"):
         from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
         fwd_spec, bwd_spec, ell_arrays = build_layouts(
-            art.src, art.dst, art.pad_inner, art.n_ext)
+            art.src, art.dst, art.pad_inner, art.n_ext,
+            geometry=art.ell_geometry)
         ell_spmm = make_ell_spmm(fwd_spec, bwd_spec,
                                  len(fwd_spec.widths), len(bwd_spec.widths),
                                  use_pallas=cfg.use_pallas)

@@ -1,16 +1,21 @@
 #!/bin/bash
 # BASELINE config 5: ogbn-papers100M, GCN 3x128, P=64, rate 0.01, multi-host
-# (reference multi-node flow, README.md:112-117). Partition offline on a
-# high-RAM host (the reference needs ~120 GB, README.md:32), then launch one
-# process per host against shared or pre-distributed partition artifacts.
+# (reference multi-node flow, README.md:112-117).
+#
+# Step 1 — partition OFFLINE on a high-RAM host (the reference needs ~120 GB,
+# README.md:32) and distribute/share the artifact dir BEFORE launching:
+#   PARTITION=1 bash scripts/ogbn-papers100m.sh
+#
+# Step 2 — launch one process per host (all hosts concurrently; rank 0 hosts
+# the jax.distributed coordinator, so no host may be delayed by other work):
 #   host 0:  NODE_RANK=0 bash scripts/ogbn-papers100m.sh
 #   host i:  NODE_RANK=i MASTER=host0-addr bash scripts/ogbn-papers100m.sh
 NODES=${NODES:-16}
 NODE_RANK=${NODE_RANK:-0}
 MASTER=${MASTER:-127.0.0.1}
 
-if [ "$NODE_RANK" = "0" ] && [ -z "$SKIP_PARTITION" ]; then
-  python -m bnsgcn_tpu.partition_cli --dataset ogbn-papers100m --n-partitions ${P:-64}
+if [ -n "$PARTITION" ]; then
+  exec python -m bnsgcn_tpu.partition_cli --dataset ogbn-papers100m --n-partitions ${P:-64}
 fi
 
 python -m bnsgcn_tpu.main \
@@ -25,6 +30,7 @@ python -m bnsgcn_tpu.main \
   --n-epochs 200 \
   --log-every 10 \
   --use-pp \
+  --eval-device mesh \
   --n-nodes $NODES --node-rank $NODE_RANK --master-addr $MASTER \
   --skip-partition \
   "$@"

@@ -21,7 +21,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _launch(rank, port, tmp, epochs, resume=False):
+def _launch(rank, port, tmp, epochs, resume=False, mesh_eval=False):
     env = os.environ.copy()
     env.update({
         "PALLAS_AXON_POOL_IPS": "",
@@ -33,10 +33,13 @@ def _launch(rank, port, tmp, epochs, resume=False):
            "--dataset", "sbm", "--n-partitions", "8", "--model", "graphsage",
            "--n-layers", "2", "--n-hidden", "16", "--n-epochs", str(epochs),
            "--log-every", "10", "--sampling-rate", "0.5", "--use-pp",
-           "--fix-seed", "--no-eval", "--skip-partition",
+           "--fix-seed", "--skip-partition",
            "--n-nodes", "2", "--node-rank", str(rank), "--port", str(port),
            "--part-path", f"{tmp}/parts", "--ckpt-path", f"{tmp}/ckpt",
            "--results-path", f"{tmp}/res"]
+    cmd.append("--eval-device" if mesh_eval else "--no-eval")
+    if mesh_eval:
+        cmd.append("mesh")
     if resume:
         cmd.append("--resume")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
@@ -73,3 +76,13 @@ def test_two_process_training_and_resume(tmp_path):
                for o in outs]
     assert losses2[0] == losses2[1]
     assert float(losses2[0]) < float(losses[0])   # training continued
+    # ELL ran multi-host (geometry from meta.json — no segment fallback)
+    assert "falling back" not in outs[0]
+
+    # mesh-distributed eval across both processes (collective test eval incl.)
+    port = _free_port()
+    procs = [_launch(r, port, tmp, epochs=12, mesh_eval=True) for r in (0, 1)]
+    outs = [p.communicate(timeout=280)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assert "Test Result" in outs[0]               # rank 0 reports
+    assert "Validation Accuracy" not in outs[1]   # rank 1 stays silent
