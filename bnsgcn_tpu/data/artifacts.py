@@ -179,8 +179,10 @@ def build_artifacts(g: Graph, part_id: np.ndarray,
         gnid[p, :k] = inner[p]
 
     from bnsgcn_tpu.ops.ell import compute_geometry
+    from bnsgcn_tpu.ops.ell_attention import gat_geometry
     n_ext_rows = pad_inner + P * pad_boundary
     geometry = compute_geometry(src_a, dst_a, pad_inner, n_ext_rows)
+    geometry["gat_fwd"] = gat_geometry(src_a, dst_a, pad_inner, n_ext_rows)
 
     return PartitionArtifacts(
         n_parts=P, pad_inner=pad_inner, pad_boundary=pad_boundary,

@@ -95,6 +95,9 @@ class GraphEnv:
     aggregate: Optional[Callable] = None
     # aggregate(h_ext [n_src_ext, d]) -> [n_dst, d]: scatter-free ELL SpMM
     # (ops/ell.py) when set; falls back to segment_sum otherwise
+    gat_ell: Optional[tuple] = None
+    # (GatEllSpec, arrays dict): dense per-row GAT attention over the ELL
+    # layout (ops/ell_attention.py) when set; segment softmax otherwise
 
 
 def env_agg_sum(env: "GraphEnv", h_ext: jax.Array) -> jax.Array:
@@ -258,6 +261,13 @@ def _gat_layer(p, h_dst, h_ext, presence, env: GraphEnv, heads, out_feats,
         # eval: h_dst is a prefix of h_ext and dropout is off — reuse z
         zd = z[:h_dst.shape[0]]
     er = (zd * p["attn_r"][None]).sum(-1)                 # [n_dst, heads]
+    if env.gat_ell is not None:
+        # dense per-row attention over the ELL layout — no COO edge arrays
+        from bnsgcn_tpu.ops.ell_attention import gat_ell_attention
+        spec_e, arrays_e = env.gat_ell
+        out = gat_ell_attention(spec_e, arrays_e, z, el, er, presence,
+                                r3, dropout, training, negative_slope)
+        return out + p["bias"].reshape(1, heads, out_feats)
     er_pad = jnp.concatenate([er, jnp.zeros((1, heads), er.dtype)], 0)
     e = el[env.src] + er_pad[jnp.minimum(env.dst, env.n_dst)]
     e = jax.nn.leaky_relu(e, negative_slope)

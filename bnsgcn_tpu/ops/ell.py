@@ -185,7 +185,8 @@ def _part_edges(src, dst, n_dst, direction):
 
 
 def compute_geometry(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
-                     n_src_ext: int, cap: int = ELL_SPLIT_CAP) -> dict:
+                     n_src_ext: int, cap: int = ELL_SPLIT_CAP,
+                     directions: tuple = ("fwd", "bwd")) -> dict:
     """Global ELL geometry (widths, padded rows, split/chunk pads) for both
     directions — a pure graph property needing the FULL set of parts.
     JSON-serializable so the offline partitioner can store it in meta.json,
@@ -193,7 +194,7 @@ def compute_geometry(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
     alone (data/artifacts.py)."""
     P = src_all.shape[0]
     geo = {}
-    for direction in ("fwd", "bwd"):
+    for direction in directions:
         n_rows = n_dst if direction == "fwd" else n_src_ext
         degs = []
         for p in range(P):

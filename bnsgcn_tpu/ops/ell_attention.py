@@ -1,0 +1,164 @@
+"""GAT attention over the ELL layout — dense per-row edge softmax.
+
+The segment-softmax GAT path (ops/spmm.segment_softmax + segment sums) runs
+three scatter-shaped passes over the edge list. With destination rows in ELL
+form (ops/ell.py, built WITHOUT the split cap so every dst row is one table
+row), the edge softmax becomes a dense masked softmax over the row width and
+the weighted sum a dense einsum — the DGL edge-softmax replacement (SURVEY
+§2.4) in the same scatter-free shape as the SpMM. The geometry is the
+uncapped 'fwd' entry of ops/ell.compute_geometry and rides meta.json like the
+SpMM geometry, so multi-host processes build the layout from local parts.
+
+Forward-only formulation: the backward runs through JAX AD (gather transposes
+to scatter-add); a transposed-layout custom VJP is the planned follow-up.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bnsgcn_tpu.ops.ell import build_ell_numpy, compute_geometry
+
+
+@dataclass(frozen=True)
+class GatEllSpec:
+    widths: tuple[int, ...]
+    rows: tuple[int, ...]
+    n_rows: int                        # dst rows (pad_inner)
+    n_src: int                         # extended rows
+
+
+def gat_geometry(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
+                 n_src_ext: int) -> dict:
+    """Uncapped fwd geometry (whole rows — the softmax can't span split
+    chunks); same schema as compute_geometry entries, JSON-serializable."""
+    return compute_geometry(src_all, dst_all, n_dst, n_src_ext, cap=None,
+                            directions=("fwd",))["fwd"]
+
+
+def build_gat_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
+                      n_src_ext: int,
+                      geometry: dict | None = None) -> tuple[GatEllSpec, dict]:
+    """Dst-major uncapped ELL layout plus per-table-position row ids.
+
+    `geometry` may come from meta.json (multi-host partial parts). Returns
+    (spec, arrays): {'gat_idx_k': [P, R_k, W_k], 'gat_rows': [P, T],
+    'gat_perm': [P, n_dst]}."""
+    P = src_all.shape[0]
+    if geometry is None:
+        geometry = gat_geometry(src_all, dst_all, n_dst, n_src_ext)
+    widths = tuple(geometry["widths"])
+    rows_max = tuple(geometry["rows"])
+
+    idx_stacked = [[] for _ in widths]
+    perms, rows_ids = [], []
+    total = sum(rows_max)
+    for p in range(P):
+        _, _, idx, perm, _, _ = build_ell_numpy(
+            src_all[p], dst_all[p], n_dst, n_src_ext,
+            widths=widths, row_pad=rows_max, cap=None)
+        for k in range(len(widths)):
+            idx_stacked[k].append(idx[k])
+        perms.append(perm)
+        row_of = np.full(total, n_dst, dtype=np.int32)   # pad -> trash dst row
+        real = perm < total                              # degree-0 rows point at total
+        row_of[perm[real]] = np.nonzero(real)[0]
+        rows_ids.append(row_of)
+    spec = GatEllSpec(widths=widths, rows=rows_max, n_rows=n_dst,
+                      n_src=n_src_ext)
+    arrays = {"gat_perm": np.stack(perms), "gat_rows": np.stack(rows_ids)}
+    for k in range(len(widths)):
+        arrays[f"gat_idx_{k}"] = np.stack(idx_stacked[k])
+    return spec, arrays
+
+
+def _attn_bucket(zp, elp, erp, pres, idx, rows, n_src, rng, dropout, training,
+                 negative_slope, chunk_gathers: int = 2_000_000):
+    """Masked softmax + weighted sum for one bucket, row-chunked so the
+    [rows, W, heads(, F')] intermediates stay HBM-bounded (the attention
+    analog of ops/ell._bucket_sum's chunking)."""
+    heads, fdim = zp.shape[1], zp.shape[2]
+    r, w = idx.shape
+
+    def tile(idx_t, rows_t, key):
+        mask = idx_t != n_src
+        if pres is not None:
+            mask = mask & pres[idx_t]
+        e = elp[idx_t] + erp[rows_t][:, None, :]         # [r, W, heads]
+        e = jax.nn.leaky_relu(e, negative_slope)
+        e = jnp.where(mask[:, :, None], e.astype(jnp.float32), -1e30)
+        m = jnp.max(e, axis=1, keepdims=True)
+        ex = jnp.exp(e - jnp.maximum(m, -1e29))
+        ex = jnp.where(mask[:, :, None], ex, 0.0)
+        denom = jnp.maximum(ex.sum(axis=1, keepdims=True), 1e-16)
+        alpha = (ex / denom).astype(zp.dtype)
+        if training and key is not None and dropout > 0.0:
+            keep = 1.0 - dropout
+            bmask = jax.random.bernoulli(key, keep, alpha.shape)
+            alpha = jnp.where(bmask, alpha / keep, 0.0).astype(zp.dtype)
+        return jnp.einsum("rwh,rwhf->rhf", alpha, zp[idx_t])
+
+    rows_per_chunk = max(1, chunk_gathers // max(w, 1))
+    if r <= rows_per_chunk:
+        return tile(idx, rows, rng)
+    n_chunks = -(-r // rows_per_chunk)
+    pad = n_chunks * rows_per_chunk - r
+    idx_p = jnp.pad(idx, ((0, pad), (0, 0)), constant_values=n_src)
+    rows_p = jnp.pad(rows, (0, pad), constant_values=elp.shape[0] - 1)
+    keys = (jax.random.split(rng, n_chunks) if (training and rng is not None
+                                                and dropout > 0.0)
+            else jnp.zeros((n_chunks, 2), jnp.uint32))
+
+    def body(_, args):
+        ix, rw, key_bits = args
+        key = (jax.random.wrap_key_data(key_bits)
+               if training and rng is not None and dropout > 0.0 else None)
+        return None, tile(ix, rw, key)
+
+    key_data = (jax.vmap(jax.random.key_data)(keys)
+                if training and rng is not None and dropout > 0.0 else keys)
+    _, out = jax.lax.scan(
+        body, None,
+        (idx_p.reshape(n_chunks, rows_per_chunk, w),
+         rows_p.reshape(n_chunks, rows_per_chunk), key_data))
+    return out.reshape(n_chunks * rows_per_chunk, heads, fdim)[:r]
+
+
+def gat_ell_attention(spec: GatEllSpec, arrays: dict, z: jax.Array,
+                      el: jax.Array, er: jax.Array,
+                      presence: jax.Array | None,
+                      attn_rng, attn_dropout: float, training: bool,
+                      negative_slope: float = 0.2) -> jax.Array:
+    """out[v] = sum_u softmax_u(leaky(el[u] + er[v])) * z[u] over v's ELL row.
+
+    z: [n_ext, heads, F'], el: [n_ext, heads], er: [n_dst, heads].
+    Returns [n_dst, heads, F']. Padded slots and absent (unsampled) halos are
+    masked out of the softmax (the reference's sampled-subgraph semantics,
+    train.py:256-281).
+    """
+    heads, fdim = z.shape[1], z.shape[2]
+    zp = jnp.concatenate([z, jnp.zeros((1, heads, fdim), z.dtype)], 0)
+    elp = jnp.concatenate([el, jnp.zeros((1, heads), el.dtype)], 0)
+    erp = jnp.concatenate([er, jnp.zeros((1, heads), er.dtype)], 0)
+    pres = None
+    if presence is not None:
+        pres = jnp.concatenate([presence, jnp.zeros((1,), bool)], 0)
+
+    outs = []
+    offset = 0
+    for k, w in enumerate(spec.widths):
+        idx = arrays[f"gat_idx_{k}"]                     # [R, W]
+        r = idx.shape[0]
+        rows = jax.lax.dynamic_slice_in_dim(arrays["gat_rows"], offset, r)
+        offset += r
+        rng_k = (jax.random.fold_in(attn_rng, k)
+                 if attn_rng is not None else None)
+        outs.append(_attn_bucket(zp, elp, erp, pres, idx, rows, spec.n_src,
+                                 rng_k, attn_dropout, training, negative_slope))
+    outs.append(jnp.zeros((1, heads, fdim), z.dtype))    # degree-0 target
+    table = jnp.concatenate(outs, axis=0)
+    return table[arrays["gat_perm"]]

@@ -135,7 +135,8 @@ class StepFns:
 
 
 def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
-               rng, edge_chunk: int, training: bool, aggregate=None) -> GraphEnv:
+               rng, edge_chunk: int, training: bool, aggregate=None,
+               gat_ell=None) -> GraphEnv:
     return GraphEnv(
         src=blk.get("src"), dst=blk.get("dst"), n_dst=hspec.pad_inner,
         in_norm=blk["in_norm"], out_norm=blk["out_norm"],
@@ -144,7 +145,7 @@ def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
                    if spec.model == "gat" and "feat0_ext" in blk else None),
         training=training, rng=rng, edge_chunk=edge_chunk,
         axis_name=hspec.axis_name, inner_mask=blk["inner_mask"],
-        aggregate=aggregate,
+        aggregate=aggregate, gat_ell=gat_ell,
     )
 
 
@@ -184,11 +185,28 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                                  use_pallas=cfg.use_pallas)
         ell_keys = tuple(ell_arrays.keys())
 
+    # dense per-row GAT attention over an (uncapped) ELL layout; geometry
+    # comes from meta.json ('gat_fwd') or is computed when all parts are local
+    gat_spec, gat_keys = None, ()
+    if cfg.spmm == "ell" and spec.model == "gat":
+        geo = (art.ell_geometry or {}).get("gat_fwd")
+        if geo is not None or art.feat.shape[0] == art.n_parts:
+            from bnsgcn_tpu.ops.ell_attention import build_gat_layouts
+            gat_spec, gat_arrays = build_gat_layouts(
+                art.src, art.dst, art.pad_inner, art.n_ext, geometry=geo)
+            ell_arrays.update(gat_arrays)
+            gat_keys = tuple(gat_arrays.keys())
+
     def _aggregate_for(blk):
         if ell_spmm is None:
             return None
         arrays = {k: blk[k] for k in ell_keys}
         return lambda h_ext: ell_spmm(arrays, h_ext)
+
+    def _gat_ell_for(blk):
+        if gat_spec is None:
+            return None
+        return (gat_spec, {k: blk[k] for k in gat_keys})
 
     def local_loss(params, state, blk, tables, epoch, sample_key, drop_key):
         blk = {k: v[0] for k, v in blk.items()}
@@ -196,7 +214,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         me = jax.lax.axis_index(axis)
         rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
-                         aggregate=_aggregate_for(blk))
+                         aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk))
         logits, new_state = apply_model(params, state, spec, blk["feat"], env)
         if multilabel:
             ls = bce_sum(logits, blk["label"], blk["train_mask"])
@@ -231,7 +249,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         if drop_key is not None:
             rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
-                         aggregate=_aggregate_for(blk))
+                         aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk))
         logits, _ = apply_model(params, state, spec, blk["feat"], env)
         return logits[None]
 
@@ -256,7 +274,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         plan = make_halo_plan(hspec_full, tables_full, blk["bnd"], zero,
                               jax.random.key(0))
         env = _local_env(spec, hspec_full, blk, plan, None, cfg.edge_chunk,
-                         False, aggregate=_aggregate_for(blk))
+                         False, aggregate=_aggregate_for(blk),
+                         gat_ell=_gat_ell_for(blk))
         logits, _ = apply_model(params, state, spec, blk["feat"], env)
         return logits[None]
 
@@ -311,7 +330,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                       exchange_only, static_argnames="width"),
                   eval_forward=eval_forward,
                   extra_blk=ell_arrays,
-                  drop_blk_keys=(("src", "dst") if ell_spmm is not None else ()))
+                  drop_blk_keys=(("src", "dst")
+                                 if (ell_spmm is not None or gat_spec is not None)
+                                 else ()))
     return fns, hspec, tables, tables_full
 
 
