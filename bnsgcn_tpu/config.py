@@ -66,6 +66,8 @@ class Config:
     spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'segment'
     use_pallas: bool = False            # use Pallas aggregation kernels where available
     profile_dir: str = ""               # write a jax.profiler trace of a few epochs here
+    remat: bool = False                 # rematerialize each layer in backward (saves HBM,
+                                        # recomputes activations incl. the halo exchange)
     eval_device: str = "host"           # 'host' (background thread, full graph) |
                                         # 'mesh' (distributed full-rate eval on the parts mesh)
 
@@ -138,6 +140,7 @@ def create_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--spmm", type=str, default="ell", choices=["ell", "segment"])
     both("profile-dir", type=str, default="")
+    p.add_argument("--remat", action="store_true")
     both("eval-device", type=str, default="host", choices=["host", "mesh"])
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)

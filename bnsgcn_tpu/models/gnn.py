@@ -27,6 +27,7 @@ Reference math preserved exactly:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -98,6 +99,7 @@ class GraphEnv:
     gat_ell: Optional[tuple] = None
     # (GatEllSpec, arrays dict): dense per-row GAT attention over the ELL
     # layout (ops/ell_attention.py) when set; segment softmax otherwise
+    remat: bool = False                # jax.checkpoint each layer (HBM for FLOPs+comm)
 
 
 def env_agg_sum(env: "GraphEnv", h_ext: jax.Array) -> jax.Array:
@@ -301,59 +303,77 @@ def apply_model(params, state, spec: ModelSpec, feat, env: GraphEnv):
         rngs = list(jax.random.split(env.rng, spec.n_layers))
 
     for i in range(spec.n_layers):
-        name = f"layer_{i}"
-        p = params[name]
-        is_graph_layer = i < spec.n_graph_layers
-
-        if spec.model in ("gcn", "graphsage"):
-            # dropout -> (exchange) -> layer   (module/model.py:44-51,79-86)
-            h = _dropout(h, spec.dropout, rngs[i], env.training)
-            if not is_graph_layer:
-                h = _linear(p, h)
-            elif env.training and spec.use_pp and i == 0:
-                # precomputed layer 0: pure dense matmul (module/layer.py:29-30,83-84)
-                h = _linear(p, h)
-            else:
-                h_ext, _ = env.exchange(i, h)
-                if spec.model == "gcn":
-                    h = _gcn_layer(p, h_ext, env)
-                elif (not env.training) and spec.use_pp and i == 0:
-                    # eval pp layer 0: cat(feat, mean) @ W  (module/layer.py:99-100)
-                    ah = env_agg_sum(env, h_ext) / env.in_norm[:, None]
-                    h = _linear(p, jnp.concatenate([h[:env.n_dst], ah], 1))
-                else:
-                    h = _sage_layer(p, h[:env.n_dst], h_ext, env)
-        elif spec.model == "gat":
-            out_feats = spec.layer_sizes[i + 1]
-            if is_graph_layer:
-                if env.training:
-                    if i == 0 and spec.use_pp:
-                        assert env.gat_feat0 is not None
-                        h_ext, presence = env.gat_feat0
-                        h_d = h[:env.n_dst] if h.shape[0] > env.n_dst else h
-                    else:
-                        h_ext, presence = env.exchange(i, h)
-                        h_d = h
-                else:
-                    # eval: exchange is the identity on a single device and a
-                    # full-rate halo exchange under mesh-distributed eval
-                    h_ext, presence = env.exchange(i, h)
-                    h_d = h
-                h = _gat_layer(p, h_d, h_ext, presence, env, spec.heads, out_feats,
-                               rngs[i], spec.dropout, env.training)
-                h = h.mean(1)                             # mean over heads (module/model.py:124)
-            else:
-                h = _dropout(h, spec.dropout, rngs[i], env.training)
-                h = _linear(p, h)
+        body = partial(_layer_forward, i=i, params=params, state=state,
+                       spec=spec, env=env, rng=rngs[i])
+        if env.remat and env.training:
+            # rematerialize per layer: activations (incl. the halo-extended
+            # block) are recomputed in the backward instead of stored —
+            # HBM-for-FLOPs/comm, jax.checkpoint per TPU guidance
+            h, st_i = jax.checkpoint(body)(h)
         else:
-            raise ValueError(spec.model)
-
-        if i < spec.n_layers - 1:
-            if spec.norm == "layer":
-                h = _layer_norm(params[f"norm_{i}"], h)
-            elif spec.norm == "batch":
-                h, new_state[f"norm_{i}"] = _sync_batch_norm(
-                    params[f"norm_{i}"], state[f"norm_{i}"], h, env, spec.train_size)
-            h = jax.nn.relu(h)
+            h, st_i = body(h)
+        if st_i is not None:
+            new_state[f"norm_{i}"] = st_i
 
     return h, new_state
+
+
+def _layer_forward(h, *, i, params, state, spec: ModelSpec, env: GraphEnv, rng):
+    """One layer of the stack: returns (h, bn_state_or_None). Extracted so
+    apply_model can wrap it in jax.checkpoint (remat)."""
+    name = f"layer_{i}"
+    p = params[name]
+    is_graph_layer = i < spec.n_graph_layers
+
+    if spec.model in ("gcn", "graphsage"):
+        # dropout -> (exchange) -> layer   (module/model.py:44-51,79-86)
+        h = _dropout(h, spec.dropout, rng, env.training)
+        if not is_graph_layer:
+            h = _linear(p, h)
+        elif env.training and spec.use_pp and i == 0:
+            # precomputed layer 0: pure dense matmul (module/layer.py:29-30,83-84)
+            h = _linear(p, h)
+        else:
+            h_ext, _ = env.exchange(i, h)
+            if spec.model == "gcn":
+                h = _gcn_layer(p, h_ext, env)
+            elif (not env.training) and spec.use_pp and i == 0:
+                # eval pp layer 0: cat(feat, mean) @ W  (module/layer.py:99-100)
+                ah = env_agg_sum(env, h_ext) / env.in_norm[:, None]
+                h = _linear(p, jnp.concatenate([h[:env.n_dst], ah], 1))
+            else:
+                h = _sage_layer(p, h[:env.n_dst], h_ext, env)
+    elif spec.model == "gat":
+        out_feats = spec.layer_sizes[i + 1]
+        if is_graph_layer:
+            if env.training:
+                if i == 0 and spec.use_pp:
+                    assert env.gat_feat0 is not None
+                    h_ext, presence = env.gat_feat0
+                    h_d = h[:env.n_dst] if h.shape[0] > env.n_dst else h
+                else:
+                    h_ext, presence = env.exchange(i, h)
+                    h_d = h
+            else:
+                # eval: exchange is the identity on a single device and a
+                # full-rate halo exchange under mesh-distributed eval
+                h_ext, presence = env.exchange(i, h)
+                h_d = h
+            h = _gat_layer(p, h_d, h_ext, presence, env, spec.heads, out_feats,
+                           rng, spec.dropout, env.training)
+            h = h.mean(1)                             # mean over heads (module/model.py:124)
+        else:
+            h = _dropout(h, spec.dropout, rng, env.training)
+            h = _linear(p, h)
+    else:
+        raise ValueError(spec.model)
+
+    st_i = None
+    if i < spec.n_layers - 1:
+        if spec.norm == "layer":
+            h = _layer_norm(params[f"norm_{i}"], h)
+        elif spec.norm == "batch":
+            h, st_i = _sync_batch_norm(
+                params[f"norm_{i}"], state[f"norm_{i}"], h, env, spec.train_size)
+        h = jax.nn.relu(h)
+    return h, st_i

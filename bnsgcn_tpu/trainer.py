@@ -136,7 +136,7 @@ class StepFns:
 
 def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
                rng, edge_chunk: int, training: bool, aggregate=None,
-               gat_ell=None) -> GraphEnv:
+               gat_ell=None, remat: bool = False) -> GraphEnv:
     return GraphEnv(
         src=blk.get("src"), dst=blk.get("dst"), n_dst=hspec.pad_inner,
         in_norm=blk["in_norm"], out_norm=blk["out_norm"],
@@ -145,7 +145,7 @@ def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
                    if spec.model == "gat" and "feat0_ext" in blk else None),
         training=training, rng=rng, edge_chunk=edge_chunk,
         axis_name=hspec.axis_name, inner_mask=blk["inner_mask"],
-        aggregate=aggregate, gat_ell=gat_ell,
+        aggregate=aggregate, gat_ell=gat_ell, remat=remat,
     )
 
 
@@ -214,7 +214,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         me = jax.lax.axis_index(axis)
         rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
-                         aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk))
+                         aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
+                         remat=cfg.remat)
         logits, new_state = apply_model(params, state, spec, blk["feat"], env)
         if multilabel:
             ls = bce_sum(logits, blk["label"], blk["train_mask"])

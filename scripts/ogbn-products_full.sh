@@ -1,0 +1,9 @@
+#!/bin/bash
+# Sweep P x sampling_rate (reference scripts/ogbn-products_full.sh grid).
+mkdir -p results
+for P in 5 8 10; do
+  for RATE in 0.1 0.01 0.0; do
+    P=$P bash scripts/ogbn-products.sh --sampling-rate $RATE --no-eval \
+      | tee results/ogbn-products_n${P}_p${RATE}.log
+  done
+done

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Sweep P x sampling_rate (reference scripts/yelp_full.sh grid).
+mkdir -p results
+for P in 3 6 10; do
+  for RATE in 0.1 0.01 0.0; do
+    P=$P bash scripts/yelp.sh --sampling-rate $RATE --no-eval \
+      | tee results/yelp_n${P}_p${RATE}.log
+  done
+done

@@ -197,3 +197,29 @@ def test_training_improves_accuracy_sbm():
     logits = _gather_logits(art, fns.forward(params, state, jnp.uint32(0), blk, tb, key))
     acc = float((logits.argmax(1) == g.label)[g.train_mask].mean())
     assert acc > 0.6, acc
+
+
+def test_remat_matches_no_remat():
+    """jax.checkpoint per layer changes memory, not math: losses and updated
+    params identical with and without --remat."""
+    g = synthetic_graph(n_nodes=80, avg_degree=5, n_feat=5, n_class=3, seed=90)
+    spec = ModelSpec("graphsage", (5, 8, 8, 3), norm="layer", dropout=0.2,
+                     use_pp=True, train_size=g.n_train)
+    params0, state0 = init_params(jax.random.key(9), spec)
+    params_np = jax.tree.map(np.asarray, params0)
+    mesh = make_parts_mesh(4)
+    results = {}
+    for remat in (False, True):
+        cfg = Config(model="graphsage", dropout=0.2, use_pp=True, norm="layer",
+                     n_train=g.n_train, lr=0.01, sampling_rate=0.5, remat=remat)
+        art, fns, blk, tb = _setup(g, 4, cfg, spec, mesh)
+        p = place_replicated(params_np, mesh)
+        s = place_replicated(state0, mesh)
+        _, _, opt = init_training(cfg, spec, mesh)
+        for e in range(3):
+            p, s, opt, loss = fns.train_step(p, s, opt, jnp.uint32(e), blk, tb,
+                                             jax.random.key(0), jax.random.key(1))
+        results[remat] = (float(loss), jax.tree.map(np.asarray, jax.device_get(p)))
+    assert abs(results[True][0] - results[False][0]) < 1e-5
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+                 results[True][1], results[False][1])
