@@ -165,11 +165,6 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
     mesh_eval = cfg.eval and cfg.eval_device == "mesh"
-    if mesh_eval and multi_host and cfg.inductive:
-        raise NotImplementedError(
-            "multi-host mesh eval is transductive-only for now (the inductive "
-            "path would need distributed partitioning of the eval subgraphs); "
-            "use --eval-device host on inductive multi-host runs")
     eval_val = None                    # (fns, blk, tables_full_d, art)
 
     def _eval_resources(graph, name_suffix):
@@ -186,13 +181,25 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             return fns, b, tables_full_d, art
         base = cfg.graph_name or cfg.derive_graph_name()
         cfg_e = cfg.replace(graph_name=base + name_suffix)
-        art_e = prepare_partition(cfg_e, graph)
+        if multi_host:
+            # rank 0 (which holds the eval subgraph) partitions it; everyone
+            # else waits at the barrier, then loads only its own parts
+            from jax.experimental import multihost_utils
+            if is_rank0 and not os.path.exists(
+                    os.path.join(artifacts_dir(cfg_e), "meta.json")):
+                prepare_partition(cfg_e, graph)   # build+save only when missing
+            multihost_utils.sync_global_devices(f"bnsgcn_eval_parts{name_suffix}")
+            art_e = load_artifacts(artifacts_dir(cfg_e),
+                                   parts=local_part_ids(mesh))
+        else:
+            art_e = prepare_partition(cfg_e, graph)
         fns_e, _, _, tf = build_step_fns(cfg, spec, art_e, mesh)
         b = build_block_arrays(art_e, spec.model)
         b.update(fns_e.extra_blk)
         for k in fns_e.drop_blk_keys:
             b.pop(k, None)
-        return fns_e, place_blocks(b, mesh), place_replicated(tf, mesh), art_e
+        placed = place_blocks_local(b, mesh) if multi_host else place_blocks(b, mesh)
+        return fns_e, placed, place_replicated(tf, mesh), art_e
 
     if mesh_eval:
         eval_val = _eval_resources(val_g, "-val")

@@ -21,7 +21,8 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _launch(rank, port, tmp, epochs, resume=False, mesh_eval=False):
+def _launch(rank, port, tmp, epochs, resume=False, mesh_eval=False,
+            inductive=False):
     env = os.environ.copy()
     env.update({
         "PALLAS_AXON_POOL_IPS": "",
@@ -40,6 +41,8 @@ def _launch(rank, port, tmp, epochs, resume=False, mesh_eval=False):
     cmd.append("--eval-device" if mesh_eval else "--no-eval")
     if mesh_eval:
         cmd.append("mesh")
+    if inductive:
+        cmd.append("--inductive")
     if resume:
         cmd.append("--resume")
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
@@ -86,3 +89,24 @@ def test_two_process_training_and_resume(tmp_path):
     assert all(p.returncode == 0 for p in procs), outs
     assert "Test Result" in outs[0]               # rank 0 reports
     assert "Validation Accuracy" not in outs[1]   # rank 1 stays silent
+
+
+def test_two_process_inductive_mesh_eval(tmp_path):
+    """Inductive multi-host mesh eval: rank 0 partitions the eval subgraphs
+    behind a barrier; all ranks join the collective val/test evals."""
+    tmp = str(tmp_path)
+    env = os.environ.copy()
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                "PYTHONPATH": REPO})
+    subprocess.run([sys.executable, "-m", "bnsgcn_tpu.partition_cli",
+                    "--dataset", "sbm", "--n-partitions", "8", "--fix-seed",
+                    "--inductive", "--part-path", f"{tmp}/parts"],
+                   env=env, check=True, capture_output=True, cwd=REPO)
+    port = _free_port()
+    procs = [_launch(r, port, tmp, epochs=12, mesh_eval=True, inductive=True)
+             for r in (0, 1)]
+    outs = [p.communicate(timeout=280)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assert "Test Result" in outs[0]
+    assert "Accuracy" in outs[0]
