@@ -130,17 +130,27 @@ def main():
                                               blk, tables_d, skey, dkey)
     log(f"  first step (compile) {time.time() - t0:.1f}s, loss={float(loss):.4f}")
 
-    times = []
-    for e in range(1, args.epochs + 1):
+    # chain CHUNK epochs between host syncs: per-dispatch host/tunnel latency
+    # (~50ms on a tunneled chip) amortizes out of the per-epoch number, which
+    # matches the reference's free-running epoch loop
+    CHUNK = 4
+    total_t, min_t = 0.0, float("inf")
+    e = 1
+    while e <= args.epochs:
+        n = min(CHUNK, args.epochs - e + 1)
         t0 = time.perf_counter()
-        params, state, opt, loss = fns.train_step(params, state, opt, jnp.uint32(e),
-                                                  blk, tables_d, skey, dkey)
+        for _ in range(n):
+            params, state, opt, loss = fns.train_step(
+                params, state, opt, jnp.uint32(e), blk, tables_d, skey, dkey)
+            e += 1
         _ = float(loss)   # force device sync through the host read
-        times.append(time.perf_counter() - t0)
-    epoch_t = float(np.mean(times))
+        dt = time.perf_counter() - t0
+        total_t += dt
+        min_t = min(min_t, dt / n)
+    epoch_t = total_t / args.epochs
     eps = g.n_edges / epoch_t
     from bnsgcn_tpu.utils.timers import estimate_static_hbm
-    log(f"epoch time mean={epoch_t:.4f}s min={np.min(times):.4f}s "
+    log(f"epoch time mean={epoch_t:.4f}s min={min_t:.4f}s "
         f"({eps / 1e6:.1f}M edges/s/chip; baseline {BASELINE_EPOCH_S}s/rank) "
         f"loss={float(loss):.4f} "
         f"static HBM ~{estimate_static_hbm([blk], [params, opt, state]):.0f} MB "

@@ -19,6 +19,16 @@ def main(argv=None):
         cfg = cfg.replace(graph_name=cfg.derive_graph_name())
     prepare_partition(cfg, force=True)
     print(f"partition artifacts written to {artifacts_dir(cfg)}")
+    if cfg.inductive and cfg.eval_device == "mesh":
+        # pre-build the eval-subgraph partitions too, so multi-host inductive
+        # mesh eval can run from pre-distributed artifact dirs (no shared FS)
+        from bnsgcn_tpu.data.datasets import inductive_split, load_data
+        g, _, _ = load_data(cfg)
+        _, val_g, test_g = inductive_split(g)
+        for suffix, sub in (("-val", val_g), ("-test", test_g)):
+            cfg_e = cfg.replace(graph_name=cfg.graph_name + suffix)
+            prepare_partition(cfg_e, sub, force=True)
+            print(f"eval partition artifacts written to {artifacts_dir(cfg_e)}")
 
 
 if __name__ == "__main__":

@@ -189,6 +189,13 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                     os.path.join(artifacts_dir(cfg_e), "meta.json")):
                 prepare_partition(cfg_e, graph)   # build+save only when missing
             multihost_utils.sync_global_devices(f"bnsgcn_eval_parts{name_suffix}")
+            if not os.path.exists(os.path.join(artifacts_dir(cfg_e), "meta.json")):
+                # fail fast on every rank instead of deadlocking the collective
+                raise FileNotFoundError(
+                    f"eval partition artifacts missing at {artifacts_dir(cfg_e)}: "
+                    f"part_path must be a shared filesystem, or pre-distribute "
+                    f"the eval artifact dirs (partition_cli --inductive "
+                    f"--eval-device mesh builds them), or use --eval-device host")
             art_e = load_artifacts(artifacts_dir(cfg_e),
                                    parts=local_part_ids(mesh))
         else:
