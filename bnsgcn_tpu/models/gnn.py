@@ -209,7 +209,12 @@ def _sync_batch_norm(p, st, h, env: GraphEnv, whole_size, momentum=0.1, eps=1e-5
             sum_x = jax.lax.psum(sum_x, env.axis_name)
             sum_x2 = jax.lax.psum(sum_x2, env.axis_name)
         mean = sum_x / whole_size
-        var = (sum_x2 - mean * sum_x) / whole_size
+        # the reference's estimator (module/sync_bn.py:19-20) sums over ALL
+        # local rows but divides by whole_size = n_train; when n_train < the
+        # summed row count the quirky formula can go negative (where the
+        # reference would silently sqrt(NaN)) — clamp at 0, a no-op whenever
+        # the estimate is a valid variance
+        var = jnp.maximum((sum_x2 - mean * sum_x) / whole_size, 0.0)
         new_st = {"mean": (1 - momentum) * st["mean"] + momentum * jax.lax.stop_gradient(mean),
                   "var": (1 - momentum) * st["var"] + momentum * jax.lax.stop_gradient(var)}
     else:

@@ -134,7 +134,9 @@ def halo_apply(spec: HaloSpec, plan: HaloPlan, h: jax.Array) -> jax.Array:
     all-to-all with scatter-add x (1/ratio) (helper/feature_buffer.py:119-129).
     """
     P, Sp, d = spec.n_parts, spec.pad_send, h.shape[-1]
-    send = h[plan.sel] * plan.weight[..., None]                 # [P, S, d]
+    # keep the payload in h's dtype: weight is f32, and bf16*f32 would promote
+    # (doubling the wire bytes and tripping the bf16 scatter below)
+    send = (h[plan.sel] * plan.weight[..., None]).astype(h.dtype)  # [P, S, d]
     recv = jax.lax.all_to_all(send.reshape(P * Sp, d), spec.axis_name,
                               0, 0, tiled=True)                 # [P*S, d]
     buf = jnp.zeros((spec.n_halo + 1, d), dtype=h.dtype)

@@ -17,13 +17,19 @@ def main(argv=None):
     cfg = parse_config(argv)
     if not cfg.graph_name:
         cfg = cfg.replace(graph_name=cfg.derive_graph_name())
-    prepare_partition(cfg, force=True)
+    build_eval = cfg.inductive and cfg.eval_device == "mesh"
+    g = None
+    if build_eval:
+        # load the dataset ONCE and reuse it for train + eval partitions
+        from bnsgcn_tpu.data.datasets import load_data
+        g, _, _ = load_data(cfg)
+    train_g = g.subgraph(g.train_mask) if (g is not None and cfg.inductive) else g
+    prepare_partition(cfg, train_g, force=True)
     print(f"partition artifacts written to {artifacts_dir(cfg)}")
-    if cfg.inductive and cfg.eval_device == "mesh":
+    if build_eval:
         # pre-build the eval-subgraph partitions too, so multi-host inductive
         # mesh eval can run from pre-distributed artifact dirs (no shared FS)
-        from bnsgcn_tpu.data.datasets import inductive_split, load_data
-        g, _, _ = load_data(cfg)
+        from bnsgcn_tpu.data.datasets import inductive_split
         _, val_g, test_g = inductive_split(g)
         for suffix, sub in (("-val", val_g), ("-test", test_g)):
             cfg_e = cfg.replace(graph_name=cfg.graph_name + suffix)

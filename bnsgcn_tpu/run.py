@@ -189,13 +189,19 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                     os.path.join(artifacts_dir(cfg_e), "meta.json")):
                 prepare_partition(cfg_e, graph)   # build+save only when missing
             multihost_utils.sync_global_devices(f"bnsgcn_eval_parts{name_suffix}")
-            if not os.path.exists(os.path.join(artifacts_dir(cfg_e), "meta.json")):
-                # fail fast on every rank instead of deadlocking the collective
+            # agree across ranks so EVERY process fails fast (a rank that has
+            # the files must not sail into the next collective alone)
+            have = int(os.path.exists(
+                os.path.join(artifacts_dir(cfg_e), "meta.json")))
+            all_have = np.asarray(
+                multihost_utils.process_allgather(np.int64(have))).min()
+            if not all_have:
                 raise FileNotFoundError(
-                    f"eval partition artifacts missing at {artifacts_dir(cfg_e)}: "
-                    f"part_path must be a shared filesystem, or pre-distribute "
-                    f"the eval artifact dirs (partition_cli --inductive "
-                    f"--eval-device mesh builds them), or use --eval-device host")
+                    f"eval partition artifacts missing at {artifacts_dir(cfg_e)} "
+                    f"on at least one host: part_path must be a shared "
+                    f"filesystem, or pre-distribute the eval artifact dirs "
+                    f"(partition_cli --inductive --eval-device mesh builds "
+                    f"them), or use --eval-device host")
             art_e = load_artifacts(artifacts_dir(cfg_e),
                                    parts=local_part_ids(mesh))
         else:
