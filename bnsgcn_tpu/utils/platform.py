@@ -1,0 +1,47 @@
+"""Work around the axon sitecustomize pinning the TPU backend.
+
+That sitecustomize imports jax at interpreter start, so a later
+``JAX_PLATFORMS=cpu`` env request (virtual-device test meshes, the driver's
+multichip dryrun) is silently ignored. Backends initialize lazily, so
+re-asserting the choice through the config still works — as long as no
+device call has happened yet.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+
+def honor_platform_request(strict: bool = False) -> None:
+    """Re-assert the JAX_PLATFORMS env var via jax.config.
+
+    strict=True additionally verifies the backend actually matches the
+    request (initializing it), raising if the request could not be honored
+    (e.g. a device call already pinned another backend)."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+    except Exception as e:                       # config frozen post-init
+        msg = f"could not re-assert JAX_PLATFORMS={want!r}: {e}"
+        if strict:
+            raise RuntimeError(msg) from e
+        warnings.warn(msg)
+        return
+    if strict:
+        got = jax.default_backend()
+        wanted = [w.strip() for w in want.split(",") if w.strip()]
+        if got not in wanted:
+            # plugin platforms may alias (e.g. requesting 'axon' yields
+            # backend name 'tpu') — only the cpu request must hard-fail,
+            # because silently running virtual-mesh code on a real chip is
+            # the dangerous outcome
+            msg = (f"JAX_PLATFORMS={want!r} requested but backend is {got!r} "
+                   f"(a device call before honor_platform_request pinned it?)")
+            if wanted == ["cpu"]:
+                raise RuntimeError(msg)
+            warnings.warn(msg)

@@ -17,12 +17,14 @@ flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
 os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
 # The axon TPU plugin's sitecustomize imports jax at interpreter startup, so
-# the env vars above are read too late; override the config directly (backends
-# initialize lazily, so this still takes effect).
+# the env vars above are read too late; re-assert them through the config
+# (backends initialize lazily, so this still takes effect).
+from bnsgcn_tpu.utils.platform import honor_platform_request  # noqa: E402
+
+honor_platform_request(strict=True)
+
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-assert jax.default_backend() == "cpu"
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for mesh tests"
 
 import numpy as np  # noqa: E402
