@@ -77,19 +77,39 @@ def final_path(cfg) -> str:
     return os.path.join(cfg.ckpt_path, f"{name}_p{cfg.sampling_rate:.2f}_final.ckpt")
 
 
-def latest_checkpoint(cfg) -> Optional[str]:
-    """Most recent periodic checkpoint for --resume."""
+def _periodic_ckpts(cfg) -> list[tuple[int, str]]:
+    """(epoch, filename) of this run's periodic checkpoints (graph-name +
+    rate scoped) — the single place that parses the periodic_path convention.
+    Non-integer suffixes (`_final.ckpt`) never match."""
+    if not os.path.isdir(cfg.ckpt_path):
+        return []
     name = cfg.graph_name or cfg.derive_graph_name()
     prefix = f"{name}_p{cfg.sampling_rate:.2f}_"
-    if not os.path.isdir(cfg.ckpt_path):
-        return None
-    best_ep, best = -1, None
+    found = []
     for fn in os.listdir(cfg.ckpt_path):
         if fn.startswith(prefix) and fn.endswith(".ckpt"):
             try:
-                ep = int(fn[len(prefix):-len(".ckpt")])
+                found.append((int(fn[len(prefix):-len(".ckpt")]), fn))
             except ValueError:
                 continue
-            if ep > best_ep:
-                best_ep, best = ep, os.path.join(cfg.ckpt_path, fn)
-    return best
+    return sorted(found)
+
+
+def prune_checkpoints(cfg, keep: int):
+    """Delete all but the newest `keep` periodic checkpoints of this run.
+    keep <= 0 keeps everything. Bounds the reference's unbounded snapshot
+    growth (a 3000-epoch reference-recipe run writes 300 full state_dicts,
+    train.py:428); the final (best-val) checkpoint is never pruned."""
+    if keep <= 0:
+        return
+    for _, fn in _periodic_ckpts(cfg)[:-keep]:
+        try:
+            os.remove(os.path.join(cfg.ckpt_path, fn))
+        except OSError:
+            pass                    # already gone (concurrent prune) — fine
+
+
+def latest_checkpoint(cfg) -> Optional[str]:
+    """Most recent periodic checkpoint for --resume."""
+    found = _periodic_ckpts(cfg)
+    return os.path.join(cfg.ckpt_path, found[-1][1]) if found else None

@@ -51,6 +51,8 @@ class Config:
     ckpt_path: str = "./checkpoint/"
     results_path: str = "./results/"
     resume: bool = False                # capability upgrade: reference is save-only (train.py:428)
+    keep_ckpt: int = 5                  # retain the newest N periodic checkpoints (0 = keep all;
+                                        # the reference keeps every snapshot, train.py:428)
 
     # --- distributed / launcher (reference helper/parser.py:47-56) ---
     backend: str = "xla"                # XLA collectives; 'gloo'/'mpi' accepted as aliases
@@ -147,6 +149,7 @@ def create_parser() -> argparse.ArgumentParser:
     both("ckpt-path", type=str, default="./checkpoint/")
     both("results-path", type=str, default="./results/")
     p.add_argument("--resume", action="store_true")
+    both("keep-ckpt", type=int, default=5)
     both("n-nodes", type=int, default=1)
     return p
 

@@ -66,12 +66,20 @@ def _load_ogb(name: str, data_path: str) -> Graph:
         m = np.zeros(n, dtype=bool)
         m[split[key]] = True
         masks[mname] = m
+    # papers100M labels are NaN for unlabeled nodes; a raw int cast would be
+    # implementation-defined garbage (typically INT64_MIN). Pin them to the
+    # -1 sentinel explicitly: every use is masked to labeled splits, and -1
+    # keeps n_class = label.max()+1 honest (reference .long() semantics made
+    # explicit, helper/utils.py:43-44).
+    label = label.reshape(-1)
+    if np.issubdtype(label.dtype, np.floating):
+        label = np.nan_to_num(label, nan=-1.0)
     return Graph(
         n_nodes=n,
         src=graph["edge_index"][0].astype(np.int64),
         dst=graph["edge_index"][1].astype(np.int64),
         feat=graph["node_feat"].astype(np.float32),
-        label=label.reshape(-1).astype(np.int64),
+        label=label.astype(np.int64),
         **masks,
     )
 

@@ -231,9 +231,15 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             latest = ckpt.latest_checkpoint(cfg)
             if latest:
                 payload = ckpt.load_checkpoint(latest)
-        have = multihost_utils.broadcast_one_to_all(
-            np.int64(0 if payload is None else int(payload["epoch"]) + 1))
+        # broadcast [next_epoch, saved_seed] together: the resumed run must
+        # continue the checkpoint's BNS-sampling/dropout streams, and every
+        # process must agree on them (shared-PRNG invariant)
+        have, saved_seed = (int(x) for x in multihost_utils.broadcast_one_to_all(
+            np.asarray([0 if payload is None else int(payload["epoch"]) + 1,
+                        seed if payload is None else int(payload.get("seed", seed))],
+                       dtype=np.int64)))
         if int(have) > 0:
+            seed = saved_seed
             host = ckpt.restore_into(payload, jax.device_get(params),
                                      jax.device_get(opt_state),
                                      jax.device_get(state)) if is_rank0 else (
@@ -277,6 +283,10 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             state = place_replicated(s, mesh)
             start_epoch = int(payload["epoch"]) + 1
             best_acc = float(payload["best_acc"])
+            # adopt the checkpoint's seed: main.py re-randomizes cfg.seed per
+            # launch, but a resumed run must continue the saved sampling and
+            # dropout streams (checkpoint.py's round-trip contract)
+            seed = int(payload.get("seed", seed))
             log(f"Resumed from {latest} at epoch {start_epoch}")
             # recover the best-so-far params (final ckpt) so a resumed run that
             # never beats the old best still saves/evaluates a best model; the
@@ -366,6 +376,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             ckpt.save_checkpoint(ckpt.periodic_path(cfg, epoch),
                                  params=params, opt_state=opt_state, bn_state=state,
                                  epoch=epoch, best_acc=best_acc, seed=seed)
+            ckpt.prune_checkpoints(cfg, cfg.keep_ckpt)
         if mesh_eval and (epoch + 1) % cfg.log_every == 0:
             fns_e, blk_e, tf_e, art_e = eval_val
             modes = ("val",) if cfg.inductive else ("val", "test")

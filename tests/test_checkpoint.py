@@ -45,12 +45,60 @@ def test_latest_checkpoint_selection(tmp_path):
     assert ckpt.latest_checkpoint(cfg.replace(sampling_rate=0.1)) is None
 
 
+def test_prune_checkpoints_keeps_newest_and_final(tmp_path):
+    cfg = Config(dataset="sbm", n_partitions=2, sampling_rate=0.5,
+                 ckpt_path=str(tmp_path), graph_name="g", keep_ckpt=2)
+    spec = ModelSpec("gcn", (4, 4, 2), norm=None)
+    params, _ = init_params(jax.random.key(0), spec)
+    for ep in (4, 9, 19, 29):
+        ckpt.save_checkpoint(ckpt.periodic_path(cfg, ep), params=params, epoch=ep)
+    ckpt.save_checkpoint(ckpt.final_path(cfg), params=params, epoch=29)
+    # a different-rate run in the same dir must be untouched
+    other = cfg.replace(sampling_rate=0.1)
+    ckpt.save_checkpoint(ckpt.periodic_path(other, 3), params=params, epoch=3)
+    ckpt.prune_checkpoints(cfg, cfg.keep_ckpt)
+    left = sorted(os.listdir(tmp_path))
+    assert os.path.basename(ckpt.periodic_path(cfg, 19)) in left
+    assert os.path.basename(ckpt.periodic_path(cfg, 29)) in left
+    assert os.path.basename(ckpt.periodic_path(cfg, 4)) not in left
+    assert os.path.basename(ckpt.periodic_path(cfg, 9)) not in left
+    assert os.path.basename(ckpt.final_path(cfg)) in left
+    assert os.path.basename(ckpt.periodic_path(other, 3)) in left
+    # keep=0 disables pruning
+    ckpt.prune_checkpoints(cfg.replace(keep_ckpt=0), 0)
+    assert os.path.basename(ckpt.periodic_path(cfg, 19)) in os.listdir(tmp_path)
+
+
 def test_atomic_write_no_tmp_left(tmp_path):
     spec = ModelSpec("gcn", (4, 4, 2), norm=None)
     params, _ = init_params(jax.random.key(0), spec)
     path = str(tmp_path / "x.ckpt")
     ckpt.save_checkpoint(path, params=params)
     assert os.path.exists(path) and not os.path.exists(path + ".tmp")
+
+
+def test_resume_adopts_checkpoint_seed(tmp_path):
+    """A resumed run must continue the saved BNS/dropout streams even when the
+    relaunch got a different randomized cfg.seed (main.py re-rolls per launch):
+    losses after resume match the uninterrupted run bit-for-bit."""
+    from bnsgcn_tpu.data.graph import sbm_graph
+    from bnsgcn_tpu.run import run_training
+
+    g = sbm_graph(n_nodes=240, n_class=3, n_feat=8, p_in=0.12, p_out=0.01,
+                  seed=3)
+    base = Config(dataset="sbm", model="graphsage", n_partitions=2,
+                  n_layers=2, n_hidden=8, sampling_rate=0.5, dropout=0.5,
+                  use_pp=True, eval=False, n_epochs=8, log_every=2, seed=7,
+                  part_path=str(tmp_path / "parts"),
+                  ckpt_path=str(tmp_path / "ckpt_a"),
+                  results_path=str(tmp_path / "res"))
+    full = run_training(base, g=g, verbose=False)
+    # interrupted run: 4 epochs (ckpts at 1,3), then resume with a DIFFERENT seed
+    cfg_b = base.replace(ckpt_path=str(tmp_path / "ckpt_b"), n_epochs=4)
+    run_training(cfg_b, g=g, verbose=False)
+    resumed = run_training(cfg_b.replace(n_epochs=8, resume=True, seed=999),
+                           g=g, verbose=False)
+    np.testing.assert_allclose(resumed.losses, full.losses[4:], rtol=1e-6)
 
 
 def test_assert_replicated_passes_on_replicated():

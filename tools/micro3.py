@@ -1,0 +1,58 @@
+"""Slope-method microbench, single compile per config (dynamic trip count)."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+def total_time(g, iters, *args):
+    t0 = time.perf_counter()
+    out = g(jnp.int32(iters), *args)
+    _ = float(jnp.asarray(out).reshape(-1)[0].astype(jnp.float32))
+    return time.perf_counter() - t0
+
+def slope(fn, *args, K=20):
+    g = jax.jit(fn)
+    _ = total_time(g, 2, *args)  # compile + warm
+    tA = min(total_time(g, K, *args) for _ in range(2))
+    tB = min(total_time(g, 2 * K, *args) for _ in range(2))
+    return (tB - tA) / K
+
+rng = np.random.default_rng(0)
+
+def mm_dep(iters, a, b0):
+    K = b0.shape[0]
+    def body(i, b):
+        c = a @ b
+        return (c[:K] * jnp.bfloat16(0.001)).astype(jnp.bfloat16) + b0
+    return jax.lax.fori_loop(0, iters, body, b0)
+
+for B, K, Nn in [(16384, 16384, 256), (32768, 8192, 256), (16384, 16384, 512)]:
+    a = jnp.asarray(rng.normal(size=(B, K)), dtype=jnp.bfloat16)
+    b = jnp.asarray(rng.normal(size=(K, Nn)), dtype=jnp.bfloat16)
+    dt = slope(mm_dep, a, b, K=30)
+    print(f"matmul [{B},{K}]@[{K},{Nn}]: {2*B*K*Nn/dt/1e12:6.1f} TFLOP/s ({dt*1e3:.3f} ms/iter)", flush=True)
+
+def gather_dep(iters, h, ix):
+    def body(i, carry):
+        acc, off = carry
+        ix2 = (ix + off) % h.shape[0]
+        s = h[ix2].sum(axis=0)
+        return (acc + s.astype(jnp.float32), off + 1)
+    acc, _ = jax.lax.fori_loop(0, iters, body,
+                               (jnp.zeros((h.shape[1],), jnp.float32), jnp.int32(0)))
+    return acc
+
+N = 131072
+M = 8_000_000
+idx = jnp.asarray(rng.integers(0, N, size=M, dtype=np.int32))
+for W in [128, 256, 512]:
+    h = jnp.asarray(rng.normal(size=(N, W)), dtype=jnp.bfloat16)
+    dt = slope(gather_dep, h, idx, K=10)
+    print(f"gather W={W} ({W*2}B/row): {M/dt/1e6:8.1f}M rows/s  {M*W*2/dt/1e9:7.1f} GB/s", flush=True)
+
+x = jnp.asarray(rng.normal(size=(128*1024*1024,)), dtype=jnp.bfloat16)
+def stream_dep(iters, x):
+    def body(i, x):
+        return x * jnp.bfloat16(1.0000001)
+    return jax.lax.fori_loop(0, iters, body, x)
+dt = slope(stream_dep, x, K=30)
+print(f"stream 256MB: {2*x.size*2/dt/1e9:7.1f} GB/s (r+w)", flush=True)

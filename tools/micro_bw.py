@@ -1,0 +1,31 @@
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+
+def bench(f, *args, iters=20):
+    g = jax.jit(functools.partial(f, iters))
+    out = g(*args); _ = float(out.reshape(-1)[0].astype(jnp.float32))
+    t0 = time.perf_counter()
+    out = g(*args); _ = float(out.reshape(-1)[0].astype(jnp.float32))
+    return (time.perf_counter() - t0) / iters
+
+rng = np.random.default_rng(0)
+# stream: read+write 512MB
+x = jnp.asarray(rng.normal(size=(256*1024*1024,)), dtype=jnp.bfloat16)  # 512MB
+def stream(iters, x):
+    def body(i, x):
+        return x + jnp.bfloat16(1.0)
+    return jax.lax.fori_loop(0, iters, body, x)
+t = bench(stream, x, iters=10)
+print(f"stream add 512MB: {2*x.size*2/t/1e9:7.1f} GB/s (r+w)")
+
+# pure matmul chain, no extra ops: keep b fixed, accumulate into fresh c each iter
+def mm_chain(iters, a, b):
+    def body(i, acc):
+        return acc + (a @ b)
+    return jax.lax.fori_loop(0, iters, body, jnp.zeros((a.shape[0], b.shape[1]), jnp.float32))
+for B, K, Nn in [(16384, 16384, 256), (16384, 16384, 512), (4096, 4096, 4096), (8192, 8192, 1024)]:
+    a = jnp.asarray(rng.normal(size=(B, K)), dtype=jnp.bfloat16)
+    b = jnp.asarray(rng.normal(size=(K, Nn)), dtype=jnp.bfloat16)
+    t = bench(mm_chain, a, b, iters=20)
+    print(f"matmul+acc [{B},{K}]@[{K},{Nn}]: {2*B*K*Nn/t/1e12:6.1f} TFLOP/s ({t*1e3:.2f} ms)")

@@ -1,0 +1,33 @@
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+
+def bench(f, *args, iters=20):
+    g = jax.jit(functools.partial(f, iters))
+    out = g(*args); _ = float(out.reshape(-1)[0].astype(jnp.float32))
+    t0 = time.perf_counter()
+    out = g(*args); _ = float(out.reshape(-1)[0].astype(jnp.float32))
+    return (time.perf_counter() - t0) / iters
+
+rng = np.random.default_rng(0)
+def mm_chain(iters, a, b):
+    def body(i, acc):
+        return acc + (a @ b)
+    return jax.lax.fori_loop(0, iters, body, jnp.zeros((a.shape[0], b.shape[1]), jnp.float32))
+
+for (B,K,Nn), it in [((2048,2048,256), 20), ((2048,2048,256), 200), ((16384,16384,256), 20), ((16384,16384,256), 100)]:
+    a = jnp.asarray(rng.normal(size=(B, K)), dtype=jnp.bfloat16)
+    b = jnp.asarray(rng.normal(size=(K, Nn)), dtype=jnp.bfloat16)
+    t = bench(mm_chain, a, b, iters=it)
+    print(f"matmul [{B},{K}]@[{K},{Nn}] iters={it}: {2*B*K*Nn/t/1e12:6.2f} TFLOP/s ({t*1e3:.3f} ms/iter)")
+
+# unrolled chain (no while loop) as cross-check
+def mm_unroll(iters, a, b):
+    acc = jnp.zeros((a.shape[0], b.shape[1]), jnp.float32)
+    for i in range(iters):
+        acc = acc + (a @ (b + jnp.bfloat16(i)))
+    return acc
+a = jnp.asarray(rng.normal(size=(16384, 16384)), dtype=jnp.bfloat16)
+b = jnp.asarray(rng.normal(size=(16384, 256)), dtype=jnp.bfloat16)
+t = bench(mm_unroll, a, b, iters=30)
+print(f"unrolled matmul [16384,16384]@[.,256]: {2*16384*16384*256/t/1e12:6.2f} TFLOP/s ({t*1e3:.3f} ms/iter)")

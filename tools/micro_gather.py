@@ -1,0 +1,48 @@
+"""Round-2 microbench: gather rows/s vs row width, MXU bf16 matmul, on the real TPU.
+Timing: chain iters with data dependency, sync via float() host read (axon tunnel)."""
+import time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+def timeit(fn, *args, iters=10):
+    out = fn(*args)
+    _ = float(out.reshape(-1)[0].astype(jnp.float32))  # warm + compile
+    t0 = time.perf_counter()
+    out = fn(*args)
+    for _ in range(iters - 1):
+        out = fn(out if False else args[0], *args[1:]) if False else fn(*args)
+    _ = float(out.reshape(-1)[0].astype(jnp.float32))
+    return (time.perf_counter() - t0) / iters
+
+print("devices:", jax.devices(), file=sys.stderr)
+N = 131072
+M = 16_000_000
+rng = np.random.default_rng(0)
+idx = jnp.asarray(rng.integers(0, N, size=M, dtype=np.int32))
+
+for W in [64, 128, 256, 512, 1024, 2048]:
+    h = jnp.asarray(rng.normal(size=(N, W)), dtype=jnp.bfloat16)
+    m = M // max(W // 256, 1)  # keep output bytes bounded
+    ix = idx[:m]
+    f = jax.jit(lambda h, ix: h[ix].sum(axis=0))
+    t = timeit(f, h, ix, iters=5)
+    rows_s = m / t
+    gbs = m * W * 2 / t / 1e9
+    print(f"gather W={W:5d} ({W*2:5d}B/row): {rows_s/1e6:8.1f}M rows/s  {gbs:7.1f} GB/s")
+
+# gather+sum over ELL-like [rows, width] reshaped (the real access pattern)
+h = jnp.asarray(rng.normal(size=(N, 256)), dtype=jnp.bfloat16)
+for w in [16, 64, 128]:
+    r = M // w
+    ix2 = idx[:r*w].reshape(r, w)
+    f = jax.jit(lambda h, ix: h[ix.reshape(-1)].reshape(r, w, 256).sum(axis=1).sum(axis=0))
+    t = timeit(f, h, ix2, iters=5)
+    print(f"ell w={w:4d}: {(r*w)/t/1e6:8.1f}M rows/s  {(r*w)*512/t/1e9:7.1f} GB/s")
+
+# MXU bf16: [B,K]@[K,256]
+for B, K in [(4096, 4096), (8192, 8192), (16384, 16384), (32768, 8192)]:
+    a = jnp.asarray(rng.normal(size=(B, K)), dtype=jnp.bfloat16)
+    b = jnp.asarray(rng.normal(size=(K, 256)), dtype=jnp.bfloat16)
+    f = jax.jit(lambda a, b: a @ b)
+    t = timeit(f, a, b, iters=10)
+    print(f"matmul [{B},{K}]@[{K},256]: {2*B*K*256/t/1e12:6.1f} TFLOP/s")

@@ -1,0 +1,20 @@
+import time, sys
+import numpy as np, jax, jax.numpy as jnp
+t0=time.time()
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+y = (x @ x).sum()
+print(f"simple matmul dispatch+read: {float(y):.1f} in {time.time()-t0:.1f}s", flush=True)
+t0=time.time()
+def f(iters, a, b0):
+    def body(i, b):
+        c = a @ b
+        return (c[:b0.shape[0]] * jnp.bfloat16(0.001)).astype(jnp.bfloat16) + b0
+    return jax.lax.fori_loop(0, iters, body, b0)
+g = jax.jit(f)
+a = jnp.ones((8192, 8192), jnp.bfloat16); b = jnp.ones((8192, 256), jnp.bfloat16)
+out = g(jnp.int32(2), a, b); _=float(out[0,0].astype(jnp.float32))
+print(f"dyn fori_loop compile+run: {time.time()-t0:.1f}s", flush=True)
+for K in [10, 20, 40]:
+    t0=time.perf_counter()
+    out = g(jnp.int32(K), a, b); _=float(out[0,0].astype(jnp.float32))
+    print(f"K={K}: {time.perf_counter()-t0:.3f}s", flush=True)
