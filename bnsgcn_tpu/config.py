@@ -72,6 +72,11 @@ class Config:
                                         # recomputes activations incl. the halo exchange)
     eval_device: str = "host"           # 'host' (background thread, full graph) |
                                         # 'mesh' (distributed full-rate eval on the parts mesh)
+    halo_exchange: str = "padded"       # 'padded' (one all_to_all, uniform pad) |
+                                        # 'shift' (P-1 ppermute rounds, per-shift pads —
+                                        #  wire bytes track skewed boundary sizes)
+    halo_wire: str = "native"           # interconnect payload dtype for the training halo
+                                        # exchange: 'native' | 'bf16' | 'fp8' (e4m3 + scales)
 
     # fields injected from partition meta.json at load time
     # (reference helper/utils.py:134-138)
@@ -144,6 +149,8 @@ def create_parser() -> argparse.ArgumentParser:
     both("profile-dir", type=str, default="")
     p.add_argument("--remat", action="store_true")
     both("eval-device", type=str, default="host", choices=["host", "mesh"])
+    both("halo-exchange", type=str, default="padded", choices=["padded", "shift"])
+    both("halo-wire", type=str, default="native", choices=["native", "bf16", "fp8"])
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)
     both("ckpt-path", type=str, default="./checkpoint/")

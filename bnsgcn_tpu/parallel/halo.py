@@ -40,13 +40,31 @@ class HaloSpec:
     """Static exchange geometry (python ints only — safe to close over in jit).
 
     The replicated device tables (n_b, send_size, inv_ratio) travel separately
-    as a `tables` dict argument through shard_map with spec P()."""
+    as a `tables` dict argument through shard_map with spec P().
+
+    `strategy` picks the collective decomposition:
+      * 'padded' — one tiled `lax.all_to_all`, every pair padded to the global
+        max send size (round-1 behavior; best when partitions are balanced);
+      * 'shift'  — P-1 `ppermute` rounds, round k padded only to
+        max_p send_size[p, (p+k)%P]: wire bytes track the *actual* skewed
+        boundary sizes, the TPU analog of the reference's exact per-pair
+        isend sizes (helper/feature_buffer.py:111-121).
+    `wire` picks the payload dtype on the interconnect:
+      * 'native' — h.dtype as-is;
+      * 'bf16'   — cast to bfloat16 on the wire;
+      * 'fp8'    — float8_e4m3fn with one f32 scale per (sender, peer) block;
+        backward gradients are re-quantized with their own scales (a fresh
+        amax), not the activation scales — see `_a2a_wire`/`_ppermute_wire`.
+    """
     n_parts: int
     pad_inner: int
     pad_boundary: int                  # B_pad: per-pair boundary padding
     pad_send: int                      # S_pad: per-pair send padding (<= B_pad)
     axis_name: str = "parts"
     exact: bool = False                # rate == 1.0: identity ordering, no top_k
+    strategy: str = "padded"           # 'padded' | 'shift'
+    wire: str = "native"               # 'native' | 'bf16' | 'fp8'
+    shift_pads: tuple = ()             # [P-1] per-shift send widths (strategy='shift')
 
     @property
     def n_halo(self) -> int:
@@ -54,7 +72,8 @@ class HaloSpec:
 
 
 def make_halo_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
-                   rate: float, axis_name: str = "parts"
+                   rate: float, axis_name: str = "parts",
+                   strategy: str = "padded", wire: str = "native"
                    ) -> tuple[HaloSpec, dict]:
     """Derive fixed send sizes and ratios from boundary sizes + sampling rate
     (reference get_send_size/get_recv_size, train.py:107-131).
@@ -70,14 +89,32 @@ def make_halo_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
     # S_pad: one uniform per-pair send width; multiple of 8 for lane friendliness
     pad_send = max(1, int(send_size.max())) if send_size.size else 1
     pad_send = min(((pad_send + 7) // 8) * 8, pad_boundary)
+    # per-shift widths: round k only carries the (p -> p+k) pairs, so its pad
+    # is that diagonal's max — zero-size shifts are skipped entirely at trace
+    # time (static), making sparse peer topologies cost nothing
+    shift_pads = []
+    for k in range(1, P):
+        m = int(max(send_size[p, (p + k) % P] for p in range(P)))
+        shift_pads.append(0 if m == 0 else min(((m + 7) // 8) * 8, pad_send))
     spec = HaloSpec(
         n_parts=P, pad_inner=pad_inner, pad_boundary=pad_boundary,
         pad_send=pad_send, axis_name=axis_name, exact=exact,
+        strategy=strategy, wire=wire, shift_pads=tuple(shift_pads),
     )
     tables = {"n_b": jnp.asarray(n_b, jnp.int32),
               "send_size": jnp.asarray(send_size, jnp.int32),
               "inv_ratio": jnp.asarray(inv_ratio, jnp.float32)}
     return spec, tables
+
+
+def wire_bytes(spec: HaloSpec, width: int, native_bytes: int = 4) -> int:
+    """Per-device interconnect payload bytes of ONE forward exchange at the
+    given feature width (excluding the local self-block and the [P] f32
+    scales, which are negligible). The backward exchange costs the same."""
+    b = {"native": native_bytes, "bf16": 2, "fp8": 1}[spec.wire]
+    if spec.strategy == "shift":
+        return sum(spec.shift_pads) * width * b
+    return (spec.n_parts - 1) * spec.pad_send * width * b
 
 
 @dataclass
@@ -127,18 +164,129 @@ def make_halo_plan(spec: HaloSpec, tables: dict, bnd: jax.Array,
     return HaloPlan(sel=sel, weight=weight, slots=slots, presence=presence)
 
 
+# ----------------------------------------------------------------------------
+# wire codec: quantize per (sender, peer) block for the interconnect hop only.
+# fp8 rides float8_e4m3fn with one f32 scale per block; gradients on the
+# backward hop get their OWN scales (activation scales would under/overflow
+# gradient magnitudes — the standard fp8-comm pitfall).
+# ----------------------------------------------------------------------------
+
+_F8 = jnp.float8_e4m3fn
+_F8_MAX = 448.0
+
+
+def _quant(x: jax.Array, wire: str):
+    """x [..., S, d] -> (payload, scales or None); scales over the last two axes."""
+    if wire == "bf16":
+        return x.astype(jnp.bfloat16), None
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1), keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / _F8_MAX
+    return (xf / scale).astype(_F8), scale
+
+
+def _dequant(payload: jax.Array, scale, dtype):
+    if scale is None:
+        return payload.astype(dtype)
+    return (payload.astype(jnp.float32) * scale).astype(dtype)
+
+
+def _a2a_wire_impl(spec: HaloSpec, send: jax.Array) -> jax.Array:
+    P, S, d = send.shape
+    payload, scale = _quant(send, spec.wire)
+    recv = jax.lax.all_to_all(payload.reshape(P * S, d), spec.axis_name,
+                              0, 0, tiled=True).reshape(P, S, d)
+    rscale = None
+    if scale is not None:
+        rscale = jax.lax.all_to_all(scale.reshape(P, 1), spec.axis_name,
+                                    0, 0, tiled=True).reshape(P, 1, 1)
+    return _dequant(recv, rscale, send.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _a2a_wire(spec: HaloSpec, send: jax.Array) -> jax.Array:
+    return _a2a_wire_impl(spec, send)
+
+
+def _a2a_wire_fwd(spec, send):
+    return _a2a_wire_impl(spec, send), None
+
+
+def _a2a_wire_bwd(spec, _, g):
+    # tiled all_to_all is an involution: the same call routes each received
+    # block's cotangent back to its sender, re-quantized with g's own scales
+    return (_a2a_wire_impl(spec, g),)
+
+
+_a2a_wire.defvjp(_a2a_wire_fwd, _a2a_wire_bwd)
+
+
+def _ppermute_wire_impl(spec: HaloSpec, k: int, send: jax.Array) -> jax.Array:
+    P = spec.n_parts
+    perm = [(i, (i + k) % P) for i in range(P)]
+    payload, scale = _quant(send, spec.wire)
+    recv = jax.lax.ppermute(payload, spec.axis_name, perm)
+    rscale = None
+    if scale is not None:
+        rscale = jax.lax.ppermute(scale, spec.axis_name, perm)
+    return _dequant(recv, rscale, send.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ppermute_wire(spec: HaloSpec, k: int, send: jax.Array) -> jax.Array:
+    return _ppermute_wire_impl(spec, k, send)
+
+
+def _ppermute_wire_fwd(spec, k, send):
+    return _ppermute_wire_impl(spec, k, send), None
+
+
+def _ppermute_wire_bwd(spec, k, _, g):
+    return (_ppermute_wire_impl(spec, spec.n_parts - k, g),)
+
+
+_ppermute_wire.defvjp(_ppermute_wire_fwd, _ppermute_wire_bwd)
+
+
 def halo_apply(spec: HaloSpec, plan: HaloPlan, h: jax.Array) -> jax.Array:
     """One layer's halo exchange: h [pad_inner, d] -> h_ext [pad_inner + n_halo, d].
 
     Fully differentiable; the AD transpose is the reference's backward
     all-to-all with scatter-add x (1/ratio) (helper/feature_buffer.py:119-129).
+    The wire codec hops carry custom VJPs so fp8/bf16 compression applies to
+    both directions with direction-appropriate scales.
     """
     P, Sp, d = spec.n_parts, spec.pad_send, h.shape[-1]
+    if spec.strategy == "shift" and P > 1:
+        me = jax.lax.axis_index(spec.axis_name)
+        buf = jnp.zeros((spec.n_halo + 1, d), dtype=h.dtype)
+        for k in range(1, P):
+            Sk = spec.shift_pads[k - 1]
+            if Sk == 0:
+                continue                       # no pair on this diagonal sends
+            to = (me + k) % P                  # peer I send to this round
+            frm = (me - k) % P                 # peer I receive from
+            sel_k = jax.lax.dynamic_index_in_dim(plan.sel, to, 0, False)[:Sk]
+            w_k = jax.lax.dynamic_index_in_dim(plan.weight, to, 0, False)[:Sk]
+            send = (h[sel_k] * w_k[:, None]).astype(h.dtype)       # [Sk, d]
+            if spec.wire == "native":
+                perm = [(i, (i + k) % P) for i in range(P)]
+                recv = jax.lax.ppermute(send, spec.axis_name, perm)
+            else:
+                recv = _ppermute_wire(spec, k, send)
+            slots_k = jax.lax.dynamic_index_in_dim(plan.slots, frm, 0, False)[:Sk]
+            buf = buf.at[slots_k].add(recv)
+        return jnp.concatenate([h, buf[:-1]], axis=0)
+
+    # padded: one tiled all_to_all, uniform S_pad per pair.
     # keep the payload in h's dtype: weight is f32, and bf16*f32 would promote
     # (doubling the wire bytes and tripping the bf16 scatter below)
     send = (h[plan.sel] * plan.weight[..., None]).astype(h.dtype)  # [P, S, d]
-    recv = jax.lax.all_to_all(send.reshape(P * Sp, d), spec.axis_name,
-                              0, 0, tiled=True)                 # [P*S, d]
+    if spec.wire == "native":
+        recv = jax.lax.all_to_all(send.reshape(P * Sp, d), spec.axis_name,
+                                  0, 0, tiled=True)             # [P*S, d]
+    else:
+        recv = _a2a_wire(spec, send).reshape(P * Sp, d)
     buf = jnp.zeros((spec.n_halo + 1, d), dtype=h.dtype)
     buf = buf.at[plan.slots.reshape(-1)].add(recv)
     return jnp.concatenate([h, buf[:-1]], axis=0)

@@ -159,9 +159,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             blk["feat0_ext"] = out
         else:
             blk["feat"] = out
+    from bnsgcn_tpu.parallel.halo import wire_bytes
+    nb = 2 if cfg.dtype == "bfloat16" else 4
     log(f"Mesh: {cfg.n_partitions} parts | pad_inner={art.pad_inner} "
         f"pad_boundary={art.pad_boundary} pad_send={hspec.pad_send} "
-        f"edges/part={art.pad_edges}")
+        f"edges/part={art.pad_edges} | halo {hspec.strategy}/{hspec.wire}: "
+        f"{wire_bytes(hspec, cfg.n_hidden, nb) / 1e6:.2f} MB/exchange/device")
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
     mesh_eval = cfg.eval and cfg.eval_device == "mesh"

@@ -165,7 +165,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     fns.extra_blk into the build_block_arrays dict before place_blocks
     (run.run_training does this automatically)."""
     rate = cfg.sampling_rate if rate is None else rate
-    hspec, tables = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, rate)
+    hspec, tables = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, rate,
+                                   strategy=cfg.halo_exchange, wire=cfg.halo_wire)
     hspec_full, tables_full = full_rate_spec(art.n_b, art.pad_inner, art.pad_boundary)
     n_train = max(art.n_train, 1)
     multilabel = art.multilabel

@@ -1,0 +1,115 @@
+"""TPU microbenchmark for the ops that bound bnsgcn_tpu's hot path.
+
+Measures XLA gather (rows/s and GB/s vs row width), the ELL access pattern,
+narrow-N bf16 matmul (the block-dense SpMM shape), and HBM stream bandwidth.
+
+Methodology (the axon-tunneled chip adds ~70-80ms fixed host round-trip per
+dispatch, and XLA hoists loop-invariant bodies out of fori_loop):
+  * every case runs inside ONE jit with a *dynamic* trip count (single
+    compile, no unroll) and a real data dependency between iterations;
+  * per-iter time = (t(2K) - t(K)) / K — the slope cancels dispatch latency,
+    compile residue, and the final host read.
+
+Usage: python tools/microbench.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def total_time(g, iters, *args):
+    import jax.numpy as jnp
+    t0 = time.perf_counter()
+    out = g(jnp.int32(iters), *args)
+    _ = float(np.asarray(out).reshape(-1)[0])
+    return time.perf_counter() - t0
+
+
+def slope(fn, *args, K=20):
+    import jax
+    g = jax.jit(fn)
+    _ = total_time(g, 2, *args)                      # compile + warm
+    tA = min(total_time(g, K, *args) for _ in range(2))
+    tB = min(total_time(g, 2 * K, *args) for _ in range(2))
+    return max((tB - tA) / K, 1e-9)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    import jax
+    import jax.numpy as jnp
+    print("devices:", jax.devices())
+
+    rng = np.random.default_rng(0)
+    N = 131072
+    M = 4_000_000 if args.quick else 8_000_000
+    idx = jnp.asarray(rng.integers(0, N, size=M, dtype=np.int32))
+
+    def gather_dep(iters, h, ix):
+        def body(i, carry):
+            acc, off = carry
+            s = h[(ix + off) % h.shape[0]].sum(axis=0)
+            return (acc + s.astype(jnp.float32), off + 1)
+        acc, _ = jax.lax.fori_loop(
+            0, iters, body, (jnp.zeros((h.shape[1],), jnp.float32), jnp.int32(0)))
+        return acc
+
+    for W in [128, 256, 512]:
+        h = jnp.asarray(rng.normal(size=(N, W)), dtype=jnp.bfloat16)
+        dt = slope(gather_dep, h, idx, K=8)
+        print(f"gather W={W:4d} ({W*2:5d}B/row): {M/dt/1e6:8.1f}M rows/s "
+              f"{M*W*2/dt/1e9:7.1f} GB/s", flush=True)
+
+    # ELL pattern: [rows, w] index table, gather + width reduce
+    h = jnp.asarray(rng.normal(size=(N, 256)), dtype=jnp.bfloat16)
+
+    def ell_dep(iters, h, ix):
+        r, w = ix.shape
+        def body(i, carry):
+            acc, off = carry
+            g2 = h[((ix + off) % h.shape[0]).reshape(-1)].reshape(r, w, 256)
+            return (acc + g2.sum(axis=1).sum(axis=0).astype(jnp.float32), off + 1)
+        acc, _ = jax.lax.fori_loop(
+            0, iters, body, (jnp.zeros((256,), jnp.float32), jnp.int32(0)))
+        return acc
+
+    for w in [16, 128]:
+        r = M // w
+        dt = slope(ell_dep, h, idx[:r * w].reshape(r, w), K=8)
+        print(f"ell w={w:4d}: {(r*w)/dt/1e6:8.1f}M rows/s "
+              f"{(r*w)*512/dt/1e9:7.1f} GB/s", flush=True)
+
+    # narrow-N bf16 matmul (block-dense SpMM shape): b evolves each iter
+    def mm_dep(iters, a, b0):
+        K2 = b0.shape[0]
+        def body(i, b):
+            c = a @ b
+            return (c[:K2] * jnp.bfloat16(0.001)).astype(jnp.bfloat16) + b0
+        return jax.lax.fori_loop(0, iters, body, b0)
+
+    for B, K2, Nn in [(16384, 16384, 256), (32768, 8192, 256), (16384, 16384, 512)]:
+        a = jnp.asarray(rng.normal(size=(B, K2)), dtype=jnp.bfloat16)
+        b = jnp.asarray(rng.normal(size=(K2, Nn)), dtype=jnp.bfloat16)
+        dt = slope(mm_dep, a, b, K=20)
+        print(f"matmul [{B},{K2}]@[{K2},{Nn}]: {2*B*K2*Nn/dt/1e12:6.1f} TFLOP/s "
+              f"({dt*1e3:.3f} ms/iter)", flush=True)
+
+    x = jnp.asarray(rng.normal(size=(64 * 1024 * 1024,)), dtype=jnp.bfloat16)
+
+    def stream_dep(iters, x):
+        def body(i, x):
+            return x * jnp.bfloat16(1.0000001)
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    dt = slope(stream_dep, x, K=20)
+    print(f"stream 128MB r+w: {2*x.size*2/dt/1e9:7.1f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
