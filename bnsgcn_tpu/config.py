@@ -77,6 +77,10 @@ class Config:
                                         #  wire bytes track skewed boundary sizes)
     halo_wire: str = "native"           # interconnect payload dtype for the training halo
                                         # exchange: 'native' | 'bf16' | 'fp8' (e4m3 + scales)
+    streaming_artifacts: str = "auto"   # 'auto' (> 30M edges) | 'always' | 'never':
+                                        # build partition artifacts one part at a time
+    feat_storage: str = "float32"       # on-disk feature dtype for streamed artifacts
+                                        # ('bfloat16' halves papers100M-scale feature IO)
 
     # fields injected from partition meta.json at load time
     # (reference helper/utils.py:134-138)
@@ -151,6 +155,10 @@ def create_parser() -> argparse.ArgumentParser:
     both("eval-device", type=str, default="host", choices=["host", "mesh"])
     both("halo-exchange", type=str, default="padded", choices=["padded", "shift"])
     both("halo-wire", type=str, default="native", choices=["native", "bf16", "fp8"])
+    both("streaming-artifacts", type=str, default="auto",
+         choices=["auto", "always", "never"])
+    both("feat-storage", type=str, default="float32",
+         choices=["float32", "bfloat16"])
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)
     both("ckpt-path", type=str, default="./checkpoint/")

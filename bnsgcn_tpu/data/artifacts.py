@@ -200,6 +200,239 @@ _PER_PART = ["feat", "label", "train_mask", "val_mask", "test_mask",
              "global_nid"]
 
 
+# ----------------------------------------------------------------------------
+# streaming builder — papers100M-scale artifacts without the dense [P, ., .]
+# stack (reference handles the 111M-node / 1.6B-edge graph through DGL on a
+# 120 GB host, README.md:32, helper/utils.py:43-44; this path does the
+# equivalent with one vectorized pass over the edges + one part resident at a
+# time). Output format is identical to save_artifacts (meta.json + shared.npz
+# + part{p}.npz), so load_artifacts / multi-host partial loads work unchanged.
+# ----------------------------------------------------------------------------
+
+
+def _pow2_bucket(deg: np.ndarray) -> np.ndarray:
+    """Ladder bucket index of each positive degree for widths (4, 8, 16, ...):
+    deg in (0,4] -> 0, (4,8] -> 1, (2^j, 2^(j+1)] -> j-1 (matches
+    ops/ell._bucketize against ops/ell._choose_widths ladders exactly)."""
+    d = np.maximum(deg, 1)
+    return np.maximum(np.ceil(np.log2(d)).astype(np.int64), 2) - 2
+
+
+class _GeoAccum:
+    """Accumulates per-part degree statistics into the compute_geometry dict
+    without holding any stacked arrays: per-part pow2-bucket counts (below the
+    cap), split-row counts and chunk sums (above it), and the global max."""
+
+    def __init__(self, cap):
+        self.cap = cap
+        self.rows_max = np.zeros(64, dtype=np.int64)
+        self.split_max = 0
+        self.chunk_max = 0
+        self.max_deg = 0
+
+    def add_part(self, deg: np.ndarray):
+        deg = deg[deg > 0]
+        if deg.size == 0:
+            return
+        self.max_deg = max(self.max_deg, int(deg.max()))
+        if self.cap:
+            over = deg > self.cap
+            n_split = int(over.sum())
+            if n_split:
+                self.split_max = max(self.split_max, n_split)
+                self.chunk_max = max(self.chunk_max, int(
+                    np.ceil(deg[over] / self.cap).sum()))
+                deg = deg[~over]
+        if deg.size:
+            b = np.bincount(_pow2_bucket(deg), minlength=64)
+            self.rows_max = np.maximum(self.rows_max, b)
+
+    def finish(self) -> dict:
+        from bnsgcn_tpu.ops.ell import _choose_widths
+        if self.max_deg == 0:
+            return {"widths": [4], "rows": [0], "split": 0, "chunks": 0,
+                    "cap": None}
+        fake = np.asarray([self.max_deg])
+        widths = _choose_widths(fake, cap=self.cap)
+        eff_cap = self.cap if (self.cap and self.max_deg > self.cap) else None
+        rows = [int(r) for r in self.rows_max[:len(widths)]]
+        pad8 = lambda r: ((r + 7) // 8) * 8 if r else 0
+        split = chunks = 0
+        if eff_cap:
+            split, chunks = pad8(self.split_max), pad8(self.chunk_max)
+            rows[-1] += self.chunk_max
+        return {"widths": [int(w) for w in widths], "rows": [pad8(r) for r in rows],
+                "split": split, "chunks": chunks, "cap": eff_cap}
+
+
+def build_artifacts_streaming(g: Graph, part_id: np.ndarray, path: str,
+                              feat_dtype: str = "float32",
+                              with_gat: bool = True,
+                              node_mult: int = 8, boundary_mult: int = 8,
+                              edge_mult: int = 8, compress: bool = False,
+                              log=None) -> None:
+    """Build + write partition artifacts directly to `path`, one part resident
+    at a time. Equivalent to save_artifacts(build_artifacts(g, pid), path) up
+    to within-part edge order (aggregation is order-invariant), with:
+
+      * no [P, pad_inner, F] feature stack — peak memory is the global edge
+        arrays plus ONE part;
+      * all O(E) work vectorized (sorts/bincounts/searchsorted); the only
+        per-part python loop writes files;
+      * feat_dtype='bfloat16' halves on-disk and load-time feature bytes
+        (papers100M: 111M x 128 floats);
+      * uncompressed .npz by default (np.savez_compressed costs minutes at
+        tens of GB; pass compress=True for the small-graph behavior).
+    """
+    from bnsgcn_tpu.ops.ell import ELL_SPLIT_CAP
+    import ml_dtypes
+
+    log = log or (lambda *a: None)
+    part_id = np.asarray(part_id, dtype=np.int32)
+    P = int(part_id.max()) + 1 if part_id.size else 1
+    N = g.n_nodes
+    fdt = ml_dtypes.bfloat16 if feat_dtype == "bfloat16" else np.float32
+    in_deg_g = g.in_degrees().astype(np.float32)
+    out_deg_g = g.out_degrees().astype(np.float32)
+
+    # inner node bookkeeping (vectorized): nodes grouped by part, ascending id
+    counts = np.bincount(part_id, minlength=P).astype(np.int64)
+    off = np.concatenate([[0], np.cumsum(counts)])
+    order = np.argsort(part_id, kind="stable")
+    loc = np.empty(N, dtype=np.int64)
+    loc[order] = np.arange(N, dtype=np.int64) - np.repeat(off[:-1], counts)
+    pad_inner = _pad_to(int(counts.max()), node_mult)
+
+    src_o = part_id[g.src]
+    dst_o = part_id[g.dst]
+    cross = src_o != dst_o
+    log(f"  [stream] {N} nodes, {g.n_edges} edges, {int(cross.sum())} cross")
+
+    # boundary sets for ALL ordered pairs in one unique pass:
+    # key (u, receiver j) — uniques sorted by u, regroup by (sender p, j)
+    cu = g.src[cross].astype(np.int64)
+    cj = dst_o[cross].astype(np.int64)
+    ukey, inv = np.unique(cu * P + cj, return_inverse=True)
+    del cu, cj
+    bu = ukey // P                                   # boundary node (global)
+    bj = (ukey % P).astype(np.int32)                 # receiver
+    bp = part_id[bu]                                 # sender
+    gkey = bp.astype(np.int64) * P + bj
+    gorder = np.argsort(gkey, kind="stable")         # by (p, j), u ascending
+    nb_flat = np.bincount(gkey, minlength=P * P).astype(np.int64)
+    n_b = nb_flat.reshape(P, P).astype(np.int32)
+    goff = np.concatenate([[0], np.cumsum(nb_flat)])
+    slot = np.empty(len(ukey), dtype=np.int64)
+    slot[gorder] = np.arange(len(ukey), dtype=np.int64) - \
+        np.repeat(goff[:-1], nb_flat)
+    max_b = int(nb_flat.max()) if len(ukey) else 0
+    pad_boundary = _pad_to(max_b, boundary_mult) if max_b else boundary_mult
+    n_halo = P * pad_boundary
+    n_ext = pad_inner + n_halo
+
+    # per-edge extended source index (receiver-side slot space for cross edges)
+    ext_src = np.empty(g.n_edges, dtype=np.int64)
+    ext_src[~cross] = loc[g.src[~cross]]
+    ext_src[cross] = pad_inner + bp[inv].astype(np.int64) * pad_boundary + slot[inv]
+    del inv
+    ldst = loc[g.dst]
+
+    # group edges by DESTINATION part (the owner of each edge's aggregation)
+    eorder = np.argsort(dst_o, kind="stable")
+    e_counts = np.bincount(dst_o, minlength=P).astype(np.int64)
+    eoff = np.concatenate([[0], np.cumsum(e_counts)])
+    pad_edges = _pad_to(int(e_counts.max()), edge_mult)
+
+    geo_fwd = _GeoAccum(ELL_SPLIT_CAP)
+    geo_bwd = _GeoAccum(ELL_SPLIT_CAP)
+    geo_gat = _GeoAccum(None) if with_gat else None
+
+    os.makedirs(path, exist_ok=True)
+    save = np.savez_compressed if compress else np.savez
+    multilabel = g.label.ndim > 1
+    for p in range(P):
+        k = int(counts[p])
+        ids = order[off[p]:off[p + 1]]               # sorted global ids ✓
+        es = eoff[p], eoff[p + 1]
+        eidx = eorder[es[0]:es[1]]
+        src_p = np.zeros(pad_edges, dtype=np.int32)
+        dst_p = np.full(pad_edges, pad_inner, dtype=np.int32)
+        src_p[:len(eidx)] = ext_src[eidx]
+        dst_p[:len(eidx)] = ldst[eidx]
+
+        # sender-side boundary lists bnd[p, j, :]
+        bnd_p = np.zeros((P, pad_boundary), dtype=np.int32)
+        for j in range(P):
+            s, e = goff[p * P + j], goff[p * P + j + 1]
+            if e > s:
+                bnd_p[j, :e - s] = loc[bu[gorder[s:e]]]
+
+        # receiver-side halo out-degrees (sender q's boundary toward p)
+        out_ext = np.ones(n_ext, dtype=np.float32)
+        out_ext[:k] = out_deg_g[ids]
+        for q in range(P):
+            s, e = goff[q * P + p], goff[q * P + p + 1]
+            if e > s:
+                u = bu[gorder[s:e]]
+                base = pad_inner + q * pad_boundary
+                out_ext[base:base + (e - s)] = out_deg_g[u]
+
+        feat_p = np.zeros((pad_inner, g.n_feat), dtype=fdt)
+        feat_p[:k] = g.feat[ids]
+        if multilabel:
+            label_p = np.zeros((pad_inner, g.label.shape[1]), dtype=np.float32)
+        else:
+            label_p = np.zeros(pad_inner, dtype=np.int32)
+        label_p[:k] = g.label[ids]
+        masks = {}
+        for name, m in [("train_mask", g.train_mask), ("val_mask", g.val_mask),
+                        ("test_mask", g.test_mask)]:
+            mp = np.zeros(pad_inner, dtype=bool)
+            mp[:k] = m[ids]
+            masks[name] = mp
+        im = np.zeros(pad_inner, dtype=bool)
+        im[:k] = True
+        ind = np.ones(pad_inner, dtype=np.float32)
+        ind[:k] = in_deg_g[ids]
+        gnid = np.full(pad_inner, -1, dtype=np.int64)
+        gnid[:k] = ids
+
+        # geometry stats from this part's degrees (fwd rows = local dst,
+        # bwd rows = extended src)
+        real_d = dst_p[:len(eidx)]
+        geo_fwd.add_part(np.bincount(real_d, minlength=pad_inner))
+        geo_bwd.add_part(np.bincount(src_p[:len(eidx)], minlength=n_ext))
+        if geo_gat is not None:
+            geo_gat.add_part(np.bincount(real_d, minlength=pad_inner))
+
+        # npz can't round-trip the ml_dtypes bfloat16 dtype — store the raw
+        # bits as uint16; load_artifacts views them back per meta.feat_dtype
+        feat_disk = feat_p.view(np.uint16) if fdt != np.float32 else feat_p
+        save(os.path.join(path, f"part{p}.npz"),
+             feat=feat_disk, label=label_p, inner_mask=im, in_deg=ind,
+             out_deg_ext=out_ext, src=src_p, dst=dst_p, bnd=bnd_p,
+             global_nid=gnid, **masks)
+        log(f"  [stream] part {p}: {k} inner, {len(eidx)} edges written")
+
+    geometry = {"fwd": geo_fwd.finish(), "bwd": geo_bwd.finish()}
+    if geo_gat is not None:
+        geometry["gat_fwd"] = geo_gat.finish()
+    n_train = int(g.train_mask.sum())
+    meta = {
+        "format_version": 2,
+        "n_parts": P, "pad_inner": pad_inner,
+        "pad_boundary": pad_boundary, "pad_edges": pad_edges,
+        "n_feat": g.n_feat, "n_class": g.n_class, "n_train": n_train,
+        "multilabel": bool(multilabel),
+        "n_inner": counts.tolist(),
+        "feat_dtype": feat_dtype,
+        "ell_geometry": geometry,
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    np.savez_compressed(os.path.join(path, "shared.npz"), n_b=n_b)
+
+
 def save_artifacts(art: PartitionArtifacts, path: str):
     """Writes meta.json + shared.npz + part{p}.npz — our own partition format
     (replaces DGL's json+tensor dirs, reference helper/utils.py:94-98)."""
@@ -233,6 +466,9 @@ def load_artifacts(path: str, parts: "list[int] | None" = None) -> PartitionArti
     part_ids = list(range(meta["n_parts"])) if parts is None else list(parts)
     loaded = [np.load(os.path.join(path, f"part{p}.npz")) for p in part_ids]
     stacked = {k: np.stack([pt[k] for pt in loaded]) for k in _PER_PART}
+    if meta.get("feat_dtype", "float32") == "bfloat16":
+        import ml_dtypes
+        stacked["feat"] = stacked["feat"].view(ml_dtypes.bfloat16)
     return PartitionArtifacts(
         n_parts=meta["n_parts"], pad_inner=meta["pad_inner"],
         pad_boundary=meta["pad_boundary"], pad_edges=meta["pad_edges"],

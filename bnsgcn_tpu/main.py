@@ -42,7 +42,7 @@ def main(argv=None):
 
     if not cfg.skip_partition and cfg.node_rank == 0:
         t0 = time.time()
-        prepare_partition(cfg)
+        prepare_partition(cfg, load=False)
         print(f"partition ready in {time.time() - t0:.1f}s -> {cfg.part_path}")
 
     if cfg.n_nodes > 1:

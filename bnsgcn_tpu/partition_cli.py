@@ -24,7 +24,7 @@ def main(argv=None):
         from bnsgcn_tpu.data.datasets import load_data
         g, _, _ = load_data(cfg)
     train_g = g.subgraph(g.train_mask) if (g is not None and cfg.inductive) else g
-    prepare_partition(cfg, train_g, force=True)
+    prepare_partition(cfg, train_g, force=True, load=False)
     print(f"partition artifacts written to {artifacts_dir(cfg)}")
     if build_eval:
         # pre-build the eval-subgraph partitions too, so multi-host inductive
@@ -33,7 +33,7 @@ def main(argv=None):
         _, val_g, test_g = inductive_split(g)
         for suffix, sub in (("-val", val_g), ("-test", test_g)):
             cfg_e = cfg.replace(graph_name=cfg.graph_name + suffix)
-            prepare_partition(cfg_e, sub, force=True)
+            prepare_partition(cfg_e, sub, force=True, load=False)
             print(f"eval partition artifacts written to {artifacts_dir(cfg_e)}")
 
 

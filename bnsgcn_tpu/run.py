@@ -48,19 +48,32 @@ def artifacts_dir(cfg: Config) -> str:
 
 
 def prepare_partition(cfg: Config, g: Optional[Graph] = None,
-                      force: bool = False) -> PartitionArtifacts:
+                      force: bool = False, load: bool = True
+                      ) -> Optional[PartitionArtifacts]:
     """Offline partitioning step (reference graph_partition, helper/utils.py:73-98):
     skipped when the artifact dir already exists, like the reference's config-
-    JSON existence check (:87)."""
+    JSON existence check (:87).
+
+    Large graphs route through the streaming builder (one part resident at a
+    time, vectorized passes — the papers100M-scale path; cfg.streaming_artifacts
+    'auto' switches at 30M edges). `load=False` (offline partition_cli) writes
+    the artifacts without stacking them back into host memory."""
     path = artifacts_dir(cfg)
     if not force and os.path.exists(os.path.join(path, "meta.json")):
-        return load_artifacts(path)
+        return load_artifacts(path) if load else None
     if g is None:
         g, _, _ = load_data(cfg)
         if cfg.inductive:
             g = g.subgraph(g.train_mask)        # helper/utils.py:76-77
     pid = partition_graph(g, cfg.n_partitions, method=cfg.partition_method,
                           obj=cfg.partition_obj, seed=cfg.seed)
+    streaming = (cfg.streaming_artifacts == "always" or
+                 (cfg.streaming_artifacts == "auto" and g.n_edges > 30_000_000))
+    if streaming:
+        from bnsgcn_tpu.data.artifacts import build_artifacts_streaming
+        build_artifacts_streaming(g, pid, path, feat_dtype=cfg.feat_storage,
+                                  log=print)
+        return load_artifacts(path) if load else None
     art = build_artifacts(g, pid)
     save_artifacts(art, path)
     return art
@@ -164,7 +177,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     log(f"Mesh: {cfg.n_partitions} parts | pad_inner={art.pad_inner} "
         f"pad_boundary={art.pad_boundary} pad_send={hspec.pad_send} "
         f"edges/part={art.pad_edges} | halo {hspec.strategy}/{hspec.wire}: "
-        f"{wire_bytes(hspec, cfg.n_hidden, nb) / 1e6:.2f} MB/exchange/device")
+        f"{wire_bytes(hspec, cfg.n_hidden, nb) / 1e6:.2f} MB/exchange/device "
+        f"at hidden width {cfg.n_hidden}"
+        + ("" if spec.use_pp or spec.model == "gat" else
+           f" ({wire_bytes(hspec, max(cfg.n_feat, 1), nb) / 1e6:.2f} MB at "
+           f"layer-0 feature width {cfg.n_feat})"))
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
     mesh_eval = cfg.eval and cfg.eval_device == "mesh"
@@ -190,7 +207,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             from jax.experimental import multihost_utils
             if is_rank0 and not os.path.exists(
                     os.path.join(artifacts_dir(cfg_e), "meta.json")):
-                prepare_partition(cfg_e, graph)   # build+save only when missing
+                prepare_partition(cfg_e, graph, load=False)  # build+save only when missing
             multihost_utils.sync_global_devices(f"bnsgcn_eval_parts{name_suffix}")
             # agree across ranks so EVERY process fails fast (a rank that has
             # the files must not sail into the next collective alone)
