@@ -8,12 +8,17 @@
 //   1. greedy streaming assignment in BFS order (LDG-style: maximize
 //      neighbors already in the part, discounted by part fill) — gives
 //      locality-coherent balanced parts;
-//   2. FM-lite boundary refinement: several passes over boundary vertices,
-//      moving a vertex to the neighboring part with the best objective gain
-//      subject to a balance cap. For 'cut' the gain is the edge-cut delta;
-//      for 'vol' it is the delta in the number of (vertex, remote-part)
-//      adjacency pairs — the payload of one full-rate halo exchange, i.e.
-//      exactly what BNS compresses.
+//   2. FM-lite boundary refinement: passes over boundary vertices, moving a
+//      vertex to the neighboring part with the best objective gain subject
+//      to a balance cap. For 'cut' the gain is the (undirected) edge-cut
+//      delta. For 'vol' the gain is the TRUE communication-volume delta on
+//      the directed graph: the change in |{(u, j) : j != part(u), u has an
+//      out-edge into j}| — v's own halo-part set plus the halo-set changes
+//      of every in-neighbor of v (the dominant term), evaluated against a
+//      per-pass snapshot of out-neighbor part counts;
+//   3. multi-seed best-of: the whole pipeline runs n_seeds times and the
+//      partition with the best true objective (directed comm volume for
+//      'vol', edge cut for 'cut') wins.
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this toolchain).
 
@@ -32,7 +37,8 @@ struct Csr {
 };
 
 // Undirected CSR over the union of both edge directions, self-loops dropped.
-Csr build_csr(int64_t n, int64_t m, const int64_t* src, const int64_t* dst) {
+Csr build_csr_union(int64_t n, int64_t m, const int64_t* src,
+                    const int64_t* dst) {
   std::vector<int64_t> deg(n, 0);
   for (int64_t e = 0; e < m; ++e) {
     if (src[e] == dst[e]) continue;
@@ -52,23 +58,108 @@ Csr build_csr(int64_t n, int64_t m, const int64_t* src, const int64_t* dst) {
   return g;
 }
 
-}  // namespace
+// Directed CSR (rows = src if by_src else dst), self-loops dropped.
+Csr build_csr_directed(int64_t n, int64_t m, const int64_t* src,
+                       const int64_t* dst, bool by_src) {
+  const int64_t* row = by_src ? src : dst;
+  const int64_t* col = by_src ? dst : src;
+  std::vector<int64_t> deg(n, 0);
+  for (int64_t e = 0; e < m; ++e)
+    if (src[e] != dst[e]) ++deg[row[e]];
+  Csr g;
+  g.indptr.assign(n + 1, 0);
+  for (int64_t v = 0; v < n; ++v) g.indptr[v + 1] = g.indptr[v] + deg[v];
+  g.adj.resize(g.indptr[n]);
+  std::vector<int64_t> fill(g.indptr.begin(), g.indptr.end() - 1);
+  for (int64_t e = 0; e < m; ++e)
+    if (src[e] != dst[e]) g.adj[fill[row[e]]++] = col[e];
+  return g;
+}
 
-extern "C" {
+// Per-vertex (part -> count) lists over out-neighbors: the snapshot the vol
+// refinement queries. CSR layout; lists are short (<= min(out_deg, P)).
+struct PartCounts {
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> part;
+  std::vector<int32_t> cnt;
 
-// Returns 0 on success. out_part must hold n_nodes int32.
-int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
-                  const int64_t* dst, int32_t n_parts, int32_t objective,
-                  uint64_t seed, int32_t refine_passes, int32_t* out_part) {
-  if (n_parts <= 0 || n_nodes <= 0) return 1;
-  if (n_parts == 1) {
-    std::memset(out_part, 0, sizeof(int32_t) * n_nodes);
+  int32_t count(int64_t u, int32_t p) const {
+    for (int64_t i = indptr[u]; i < indptr[u + 1]; ++i)
+      if (part[i] == p) return cnt[i];
     return 0;
   }
-  Csr g = build_csr(n_nodes, n_edges, src, dst);
-  std::mt19937_64 rng(seed);
+};
 
-  const int64_t cap = (n_nodes + n_parts - 1) / n_parts;      // hard balance cap
+PartCounts build_part_counts(int64_t n, const Csr& out, const int32_t* part,
+                             int32_t n_parts) {
+  PartCounts pc;
+  pc.indptr.assign(n + 1, 0);
+  std::vector<int32_t> scratch(n_parts, 0);
+  std::vector<int32_t> touched;
+  // sizing pass
+  for (int64_t v = 0; v < n; ++v) {
+    touched.clear();
+    for (int64_t i = out.indptr[v]; i < out.indptr[v + 1]; ++i) {
+      int32_t p = part[out.adj[i]];
+      if (scratch[p]++ == 0) touched.push_back(p);
+    }
+    pc.indptr[v + 1] = pc.indptr[v] + static_cast<int64_t>(touched.size());
+    for (int32_t p : touched) scratch[p] = 0;
+  }
+  pc.part.resize(pc.indptr[n]);
+  pc.cnt.resize(pc.indptr[n]);
+  int64_t w = 0;
+  for (int64_t v = 0; v < n; ++v) {
+    touched.clear();
+    for (int64_t i = out.indptr[v]; i < out.indptr[v + 1]; ++i) {
+      int32_t p = part[out.adj[i]];
+      if (scratch[p]++ == 0) touched.push_back(p);
+    }
+    for (int32_t p : touched) {
+      pc.part[w] = p;
+      pc.cnt[w++] = scratch[p];
+      scratch[p] = 0;
+    }
+  }
+  return pc;
+}
+
+int64_t comm_volume_of(int64_t n, const Csr& out, const int32_t* part,
+                       int32_t n_parts) {
+  int64_t vol = 0;
+  std::vector<uint8_t> seen(n_parts, 0);
+  std::vector<int32_t> touched;
+  for (int64_t v = 0; v < n; ++v) {
+    touched.clear();
+    for (int64_t i = out.indptr[v]; i < out.indptr[v + 1]; ++i) {
+      int32_t p = part[out.adj[i]];
+      if (!seen[p]) { seen[p] = 1; touched.push_back(p); }
+    }
+    for (int32_t p : touched) {
+      if (p != part[v]) ++vol;
+      seen[p] = 0;
+    }
+  }
+  return vol;
+}
+
+int64_t edge_cut_of(const Csr& uni, const int32_t* part) {
+  int64_t cut = 0;
+  for (int64_t v = 0; v + 1 < static_cast<int64_t>(uni.indptr.size()); ++v)
+    for (int64_t i = uni.indptr[v]; i < uni.indptr[v + 1]; ++i)
+      if (part[v] != part[uni.adj[i]]) ++cut;
+  return cut / 2;  // union CSR holds both directions
+}
+
+// hubs fall back to the cut gain: their exact vol delta costs
+// O(in_deg * candidates) lookups and they rarely move profitably
+constexpr int64_t kVolScanCap = 512;
+
+void partition_once(int64_t n_nodes, const Csr& g, const Csr* out_csr,
+                    const Csr* in_csr, int32_t n_parts, int32_t objective,
+                    uint64_t seed, int32_t refine_passes, int32_t* part_out) {
+  std::mt19937_64 rng(seed);
+  const int64_t cap = (n_nodes + n_parts - 1) / n_parts;  // hard balance cap
   std::vector<int32_t> part(n_nodes, -1);
   std::vector<int64_t> size(n_parts, 0);
 
@@ -78,13 +169,12 @@ int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
   std::shuffle(order.begin(), order.end(), rng);
 
   std::vector<int64_t> nbr_count(n_parts, 0);
-  std::vector<int64_t> touched;
+  std::vector<int32_t> touched;
   std::queue<int64_t> bfs;
   int64_t cursor = 0;
   std::vector<uint8_t> queued(n_nodes, 0);
 
   auto assign = [&](int64_t v) {
-    // score: neighbors already in p, discounted by fill (LDG)
     touched.clear();
     for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
       int32_t p = part[g.adj[i]];
@@ -97,20 +187,16 @@ int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
     int32_t best_p = -1;
     for (int32_t p : touched) {
       if (size[p] >= cap) continue;
-      double score =
-          static_cast<double>(nbr_count[p]) * (1.0 - static_cast<double>(size[p]) / cap);
+      double score = static_cast<double>(nbr_count[p]) *
+                     (1.0 - static_cast<double>(size[p]) / cap);
       if (score > best_score) { best_score = score; best_p = p; }
     }
     if (best_p < 0) {
-      // no assignable neighbor part: least-filled part
       int64_t min_sz = INT64_MAX;
       for (int32_t p = 0; p < n_parts; ++p)
         if (size[p] < min_sz) { min_sz = size[p]; best_p = p; }
     }
-    for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
-      int32_t p = part[g.adj[i]];
-      if (p >= 0) nbr_count[p] = 0;
-    }
+    for (int32_t p : touched) nbr_count[p] = 0;
     part[v] = best_p;
     ++size[best_p];
     for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
@@ -135,12 +221,14 @@ int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
   }
 
   // ---- phase 2: FM-lite boundary refinement ----
-  // gain arrays reused across vertices
   std::vector<int64_t> adj_in_part(n_parts, 0);
   const double slack = 1.02;  // allow 2% imbalance during refinement
   const int64_t soft_cap = static_cast<int64_t>(cap * slack);
+  const bool vol = (objective == 0) && out_csr && in_csr;
 
   for (int32_t pass = 0; pass < refine_passes; ++pass) {
+    PartCounts pc;
+    if (vol) pc = build_part_counts(n_nodes, *out_csr, part.data(), n_parts);
     int64_t moves = 0;
     for (int64_t v = 0; v < n_nodes; ++v) {
       int32_t pv = part[v];
@@ -153,23 +241,38 @@ int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
         if (p != pv) boundary = true;
       }
       if (boundary && size[pv] > 1) {
+        const int64_t in_deg =
+            in_csr ? in_csr->indptr[v + 1] - in_csr->indptr[v] : 0;
+        const bool vol_exact = vol && in_deg <= kVolScanCap;
+        // common removal term: every in-neighbor u for which v is u's ONLY
+        // out-neighbor in pv stops treating pv as halo (snapshot counts)
+        int64_t gain_remove = 0;
+        if (vol_exact) {
+          for (int64_t i = in_csr->indptr[v]; i < in_csr->indptr[v + 1]; ++i) {
+            int64_t u = in_csr->adj[i];
+            if (part[u] != pv && pc.count(u, pv) == 1) ++gain_remove;
+          }
+        }
         int64_t best_gain = 0;
         int32_t best_p = -1;
-        for (int32_t p : touched) {
-          if (p == pv || size[p] >= soft_cap) continue;
+        for (int32_t q : touched) {
+          if (q == pv || size[q] >= soft_cap) continue;
           int64_t gain;
-          if (objective == 1) {                       // cut
-            gain = adj_in_part[p] - adj_in_part[pv];
-          } else {                                    // vol
-            // moving v: v stops being a halo for p, may become one for pv;
-            // approximate with (degree-normalized) cut gain + halo terms
-            int64_t halo_now = static_cast<int64_t>(touched.size()) - 1;
-            int64_t halo_after = halo_now;            // v still borders old part?
-            if (adj_in_part[pv] > 0) halo_after = halo_now;  // borders pv after move
-            else halo_after = halo_now - 1;
-            gain = (adj_in_part[p] - adj_in_part[pv]) + (halo_now - halo_after);
+          if (!vol) {                                 // cut
+            gain = adj_in_part[q] - adj_in_part[pv];
+          } else if (!vol_exact) {                    // hub: cut proxy
+            gain = adj_in_part[q] - adj_in_part[pv];
+          } else {
+            // own halo-set term: O = v's out-neighbor parts (snapshot)
+            gain = gain_remove;
+            gain += (pc.count(v, q) > 0 ? 1 : 0) - (pc.count(v, pv) > 0 ? 1 : 0);
+            // addition term: in-neighbors that did not see q before now do
+            for (int64_t i = in_csr->indptr[v]; i < in_csr->indptr[v + 1]; ++i) {
+              int64_t u = in_csr->adj[i];
+              if (part[u] != q && pc.count(u, q) == 0) --gain;
+            }
           }
-          if (gain > best_gain) { best_gain = gain; best_p = p; }
+          if (gain > best_gain) { best_gain = gain; best_p = q; }
         }
         if (best_p >= 0) {
           part[v] = best_p;
@@ -183,17 +286,69 @@ int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
     if (moves == 0) break;
   }
 
-  std::memcpy(out_part, part.data(), sizeof(int32_t) * n_nodes);
+  std::memcpy(part_out, part.data(), sizeof(int32_t) * n_nodes);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. out_part must hold n_nodes int32. n_seeds > 1 runs
+// the pipeline per seed and keeps the partition with the best true objective.
+int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
+                  const int64_t* dst, int32_t n_parts, int32_t objective,
+                  uint64_t seed, int32_t refine_passes, int32_t n_seeds,
+                  int32_t* out_part) {
+  if (n_parts <= 0 || n_nodes <= 0) return 1;
+  if (n_parts == 1) {
+    std::memset(out_part, 0, sizeof(int32_t) * n_nodes);
+    return 0;
+  }
+  Csr g = build_csr_union(n_nodes, n_edges, src, dst);
+  Csr out_csr, in_csr;
+  const bool vol = (objective == 0);
+  if (vol) {
+    out_csr = build_csr_directed(n_nodes, n_edges, src, dst, true);
+    in_csr = build_csr_directed(n_nodes, n_edges, src, dst, false);
+  }
+  if (n_seeds < 1) n_seeds = 1;
+  std::vector<int32_t> cand(n_nodes);
+  int64_t best_obj = INT64_MAX;
+  for (int32_t s = 0; s < n_seeds; ++s) {
+    partition_once(n_nodes, g, vol ? &out_csr : nullptr,
+                   vol ? &in_csr : nullptr, n_parts, objective,
+                   seed + static_cast<uint64_t>(s) * 0x9e3779b97f4a7c15ULL,
+                   refine_passes, cand.data());
+    int64_t obj = vol ? comm_volume_of(n_nodes, out_csr, cand.data(), n_parts)
+                      : edge_cut_of(g, cand.data());
+    if (obj < best_obj) {
+      best_obj = obj;
+      std::memcpy(out_part, cand.data(), sizeof(int32_t) * n_nodes);
+    }
+  }
   return 0;
 }
 
-// Quality metrics for tests/logging (edge cut over directed edge list).
+// Quality metrics for tests/logging (directed edge list).
 int64_t bns_edge_cut(int64_t n_edges, const int64_t* src, const int64_t* dst,
                      const int32_t* part) {
   int64_t cut = 0;
   for (int64_t e = 0; e < n_edges; ++e)
     if (part[src[e]] != part[dst[e]]) ++cut;
   return cut;
+}
+
+// Directed communication volume: |{(u, j) : j != part(u), u has out-edge
+// into j}| — the full-rate halo payload (what BNS compresses; matches
+// data/partitioner.comm_volume).
+int64_t bns_comm_volume(int64_t n_nodes, int64_t n_edges, const int64_t* src,
+                        const int64_t* dst, int32_t n_parts,
+                        const int32_t* part) {
+  Csr out_csr = build_csr_directed(n_nodes, n_edges, src, dst, true);
+  int64_t vol = comm_volume_of(n_nodes, out_csr, part, n_parts);
+  // comm_volume in data/partitioner.py counts self-loop-free out-edges only,
+  // which build_csr_directed already guarantees.
+  return vol;
 }
 
 }  // extern "C"

@@ -1,0 +1,56 @@
+"""Partition quality benchmark: native LDG+FM (vol / cut objectives) vs
+random, on skewed power-law and community (SBM) graphs.
+
+Emits the markdown table README.md's 'Partitioner quality' section carries.
+Reference counterpart: METIS objtype vol|cut via dgl.distributed.partition_graph
+(helper/utils.py:94-95).
+
+Usage: python tools/partition_quality.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bnsgcn_tpu.data.graph import sbm_graph, synthetic_graph  # noqa: E402
+from bnsgcn_tpu.data.partitioner import (comm_volume, edge_cut,  # noqa: E402
+                                         random_partition)
+from bnsgcn_tpu.native import native_partition  # noqa: E402
+
+
+def main():
+    graphs = [
+        ("power-law (20k, deg 16)", synthetic_graph(
+            n_nodes=20_000, avg_degree=16, n_feat=4, seed=2, power_law=True)),
+        ("SBM (15k, 12 blocks)", sbm_graph(
+            n_nodes=15_000, n_class=12, n_feat=4, p_in=0.004, p_out=2e-4,
+            seed=3)),
+    ]
+    print("| graph | P | method | comm volume | edge cut | time (s) |")
+    print("|---|---|---|---|---|---|")
+    for name, g in graphs:
+        for P in (8, 16):
+            rows = []
+            for method, fn in [
+                ("native vol", lambda: native_partition(g, P, obj="vol", seed=0)),
+                ("native cut", lambda: native_partition(g, P, obj="cut", seed=0)),
+                ("random", lambda: random_partition(g, P, seed=0)),
+            ]:
+                t0 = time.time()
+                pid = fn()
+                dt = time.time() - t0
+                rows.append((method, comm_volume(g, pid), edge_cut(g, pid), dt))
+            base_v, base_c = rows[-1][1], rows[-1][2]
+            for method, v, c, dt in rows:
+                print(f"| {name} | {P} | {method} | {v} ({v/base_v:.2f}x rnd) "
+                      f"| {c} ({c/base_c:.2f}x rnd) | {dt:.2f} |")
+
+
+if __name__ == "__main__":
+    main()
