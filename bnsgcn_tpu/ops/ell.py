@@ -147,12 +147,23 @@ def build_ell_numpy(src: np.ndarray, dst: np.ndarray, n_rows: int, n_src: int,
     cap_rows = rows_per_bucket[-1] if rows_per_bucket else 0
     chunk_pos = np.full(cp, cap_rows, dtype=np.int32)   # pad -> appended zero row
     chunk_seg = np.full(cp, sp, dtype=np.int32)         # pad -> dropped segment
+    # row_of[table_pos] = the output row this table row computes (split
+    # pseudo-rows map to their split source; padding -> n_rows). Consumers
+    # that need per-table-row context (GAT attention broadcasts el/z by row)
+    # index with this.
+    row_of = np.full(total, n_rows, dtype=np.int32)
+    normal = (bucket >= 0)
+    rws = np.nonzero(normal)[0]
+    row_of[perm[rws]] = rws
     if n_split:
         chunk_pos[:n_pseudo] = cap_normal + np.arange(n_pseudo)
         chunk_seg[:n_pseudo] = np.repeat(np.arange(n_split), chunks_per)
         perm[split_rows] = total + np.arange(n_split, dtype=np.int32)
+        row_of[cap_offset + cap_normal + np.arange(n_pseudo)] = \
+            np.repeat(split_rows, chunks_per)
     perm[(bucket == -1) & ~split_mask] = total + sp     # zero row
-    return tuple(widths), tuple(rows_per_bucket), idx_arrays, perm, chunk_pos, chunk_seg
+    return (tuple(widths), tuple(rows_per_bucket), idx_arrays, perm,
+            chunk_pos, chunk_seg, row_of)
 
 
 def _choose_widths(deg: np.ndarray, cap: int | None = None) -> tuple[int, ...]:
@@ -253,7 +264,7 @@ def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
         perms, cpos, csegs = [], [], []
         for p in range(P):
             s, d = _part_edges(src_all[p], dst_all[p], n_dst, direction)
-            _, _, idx, perm, cp, cs = build_ell_numpy(
+            _, _, idx, perm, cp, cs, _ = build_ell_numpy(
                 s, d, n_rows, n_src, widths=widths, row_pad=rows_max,
                 cap=eff_cap, split_pad=split_max, chunk_pad=chunk_max)
             for k in range(len(widths)):
@@ -317,27 +328,35 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
     return out.reshape(n_chunks * rows_per_chunk, h_dim)[:r]
 
 
+def ell_combine(spec: EllSpec, outs, perm, chunk_pos=None, chunk_seg=None):
+    """Per-bucket outputs [R_k, ...] -> [n_rows, ...] via the split-row chunk
+    combine (tiny sorted segment-sum) + one permutation gather. Shared by the
+    SpMM and any other bucketed row computation (GAT attention backward)."""
+    trailing = outs[0].shape[1:]
+    zero = jnp.zeros((1,) + trailing, outs[0].dtype)
+    if spec.n_split:
+        # combine split-row chunks straight from the cap bucket's output
+        # (chunk_pos is cap-bucket-relative; its pad points at the zero row)
+        cap_z = jnp.concatenate([outs[-1], zero], axis=0)
+        gathered = cap_z[chunk_pos]                    # [n_chunks, ...]
+        comb = jax.ops.segment_sum(gathered, chunk_seg,
+                                   num_segments=spec.n_split + 1,
+                                   indices_are_sorted=True)[:spec.n_split]
+        full = jnp.concatenate(list(outs) + [comb, zero], axis=0)
+    else:
+        full = jnp.concatenate(list(outs) + [zero], axis=0)
+    return full[perm]
+
+
 def _ell_apply(spec: EllSpec, idx_list, perm, h, use_pallas: bool = False,
                chunk_pos=None, chunk_seg=None):
     """Bucketed gather+sum (+ split-row combine), then one permutation gather.
     The only scatter is the tiny sorted segment-sum over split-row chunks."""
     hp = jnp.concatenate([h, jnp.zeros((1, h.shape[1]), h.dtype)], 0)  # pad row
-    zero = jnp.zeros((1, h.shape[1]), h.dtype)
     outs = []
     for k, w in enumerate(spec.widths):
         outs.append(_bucket_sum(hp, idx_list[k], w, use_pallas=use_pallas))
-    if spec.n_split:
-        # combine split-row chunks straight from the cap bucket's output
-        # (chunk_pos is cap-bucket-relative; its pad points at the zero row)
-        cap_z = jnp.concatenate([outs[-1], zero], axis=0)
-        gathered = cap_z[chunk_pos]                    # [n_chunks, H]
-        comb = jax.ops.segment_sum(gathered, chunk_seg,
-                                   num_segments=spec.n_split + 1,
-                                   indices_are_sorted=True)[:spec.n_split]
-        full = jnp.concatenate(outs + [comb, zero], axis=0)
-    else:
-        full = jnp.concatenate(outs + [zero], axis=0)
-    return full[perm]
+    return ell_combine(spec, outs, perm, chunk_pos, chunk_seg)
 
 
 def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,

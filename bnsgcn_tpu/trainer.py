@@ -174,9 +174,29 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     blk_spec = P("parts")
     rep = P()
 
-    # scatter-free ELL SpMM layouts (GCN/SAGE aggregation path)
+    # scatter-free SpMM layouts (GCN/SAGE aggregation path): 'ell' (bucketed
+    # gathers) or 'hybrid' (dense int8 adjacency tiles on the MXU + ELL
+    # residual — ops/block_spmm.py; needs all parts local for the tiling, so
+    # multi-host partial loads fall back to 'ell')
     ell_spmm, ell_keys, ell_arrays = None, (), {}
-    if cfg.spmm == "ell" and spec.model in ("gcn", "graphsage"):
+    want_hybrid = (cfg.spmm == "hybrid" and spec.model in ("gcn", "graphsage")
+                   and art.feat.shape[0] == art.n_parts)
+    if want_hybrid:
+        from bnsgcn_tpu.ops.block_spmm import (build_block_layouts,
+                                               cluster_order, make_block_spmm)
+        perms_i, perms_e = [], []
+        for p in range(art.n_parts):
+            pi, pe = cluster_order(art.src[p], art.dst[p], art.pad_inner,
+                                   art.n_ext)
+            perms_i.append(pi)
+            perms_e.append(pe)
+        fwd_b, bwd_b, ell_pair, ell_arrays = build_block_layouts(
+            art.src, art.dst, art.pad_inner, art.n_ext,
+            np.stack(perms_i), np.stack(perms_e))
+        ell_spmm = make_block_spmm(fwd_b, bwd_b, ell_pair,
+                                   use_pallas=cfg.use_pallas)
+        ell_keys = tuple(ell_arrays.keys())
+    elif cfg.spmm in ("ell", "hybrid") and spec.model in ("gcn", "graphsage"):
         from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
         fwd_spec, bwd_spec, ell_arrays = build_layouts(
             art.src, art.dst, art.pad_inner, art.n_ext,
@@ -189,12 +209,13 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     # dense per-row GAT attention over an (uncapped) ELL layout; geometry
     # comes from meta.json ('gat_fwd') or is computed when all parts are local
     gat_spec, gat_keys = None, ()
-    if cfg.spmm == "ell" and spec.model == "gat":
+    if cfg.spmm in ("ell", "hybrid") and spec.model == "gat":
         geo = (art.ell_geometry or {}).get("gat_fwd")
         if geo is not None or art.feat.shape[0] == art.n_parts:
             from bnsgcn_tpu.ops.ell_attention import build_gat_layouts
             gat_spec, gat_arrays = build_gat_layouts(
-                art.src, art.dst, art.pad_inner, art.n_ext, geometry=geo)
+                art.src, art.dst, art.pad_inner, art.n_ext, geometry=geo,
+                geometry_bwd=(art.ell_geometry or {}).get("bwd"))
             ell_arrays.update(gat_arrays)
             gat_keys = tuple(gat_arrays.keys())
 

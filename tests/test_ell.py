@@ -108,7 +108,7 @@ def test_split_rows_hub_node_matches_segment():
 def test_build_ell_numpy_basics():
     src = np.array([0, 1, 2, 3, 4, 5, 0])
     dst = np.array([0, 0, 0, 1, 1, 2, 3])
-    widths, rows, idx, perm, _, _ = build_ell_numpy(src, dst, n_rows=5, n_src=6)
+    widths, rows, idx, perm, _, _, _ = build_ell_numpy(src, dst, n_rows=5, n_src=6)
     # row 4 has degree 0 -> routed to the trailing zero row
     total = sum(rows)
     assert perm[4] == total

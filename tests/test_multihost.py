@@ -22,7 +22,7 @@ def _free_port():
 
 
 def _launch(rank, port, tmp, epochs, resume=False, mesh_eval=False,
-            inductive=False):
+            inductive=False, model="graphsage"):
     env = os.environ.copy()
     env.update({
         "PALLAS_AXON_POOL_IPS": "",
@@ -31,7 +31,7 @@ def _launch(rank, port, tmp, epochs, resume=False, mesh_eval=False,
         "PYTHONPATH": REPO,
     })
     cmd = [sys.executable, "-m", "bnsgcn_tpu.main",
-           "--dataset", "sbm", "--n-partitions", "8", "--model", "graphsage",
+           "--dataset", "sbm", "--n-partitions", "8", "--model", model,
            "--n-layers", "2", "--n-hidden", "16", "--n-epochs", str(epochs),
            "--log-every", "10", "--sampling-rate", "0.5", "--use-pp",
            "--fix-seed", "--skip-partition",
@@ -89,6 +89,31 @@ def test_two_process_training_and_resume(tmp_path):
     assert all(p.returncode == 0 for p in procs), outs
     assert "Test Result" in outs[0]               # rank 0 reports
     assert "Validation Accuracy" not in outs[1]   # rank 1 stays silent
+
+
+def test_two_process_gat_ell_attention(tmp_path):
+    """Multi-host GAT rides the ELL attention path (gat_fwd + bwd geometry
+    from meta.json — no segment fallback), trains with identical losses on
+    both ranks, and custom-VJP backward runs under jax.distributed."""
+    tmp = str(tmp_path)
+    env = os.environ.copy()
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                "PYTHONPATH": REPO})
+    subprocess.run([sys.executable, "-m", "bnsgcn_tpu.partition_cli",
+                    "--dataset", "sbm", "--n-partitions", "8", "--fix-seed",
+                    "--part-path", f"{tmp}/parts"],
+                   env=env, check=True, capture_output=True, cwd=REPO)
+    port = _free_port()
+    procs = [_launch(r, port, tmp, epochs=25, model="gat") for r in (0, 1)]
+    outs = [p.communicate(timeout=280)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    losses = [[ln for ln in o.splitlines() if "Loss" in ln] for o in outs]
+    assert losses[0] and losses[0][-1].split()[-1] == losses[1][-1].split()[-1]
+    first = float(losses[0][0].split()[-1])
+    last = float(losses[0][-1].split()[-1])
+    assert last < first, (first, last)
+    assert "falling back" not in outs[0]          # ELL attention ran
 
 
 def test_two_process_inductive_mesh_eval(tmp_path):
