@@ -44,19 +44,32 @@ def _from_dgl(dgl_graph, multilabel=False) -> Graph:
 
 
 def _load_reddit(data_path: str) -> Graph:
-    from dgl.data import RedditDataset
+    try:
+        from dgl.data import RedditDataset
+    except ImportError:
+        # dependency-free reader of DGL's on-disk layout (data/disk_readers.py)
+        from bnsgcn_tpu.data.disk_readers import load_reddit_npz
+        return load_reddit_npz(data_path)
     return _from_dgl(RedditDataset(raw_dir=data_path)[0])
 
 
 def _load_yelp(data_path: str) -> Graph:
-    from dgl.data import YelpDataset
-    g = _from_dgl(YelpDataset(raw_dir=data_path)[0], multilabel=True)
+    try:
+        from dgl.data import YelpDataset
+        g = _from_dgl(YelpDataset(raw_dir=data_path)[0], multilabel=True)
+    except ImportError:
+        from bnsgcn_tpu.data.disk_readers import load_yelp_saint
+        g = load_yelp_saint(data_path)
     g.feat = standard_scale(g.feat, g.train_mask)
     return g
 
 
 def _load_ogb(name: str, data_path: str) -> Graph:
-    from ogb.nodeproppred import NodePropPredDataset
+    try:
+        from ogb.nodeproppred import NodePropPredDataset
+    except ImportError:
+        from bnsgcn_tpu.data.disk_readers import load_ogb_disk
+        return load_ogb_disk(name, data_path)
     ds = NodePropPredDataset(name=name, root=data_path)
     split = ds.get_idx_split()
     graph, label = ds[0]

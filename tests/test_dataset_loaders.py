@@ -58,6 +58,119 @@ def test_from_dgl_multilabel():
     assert g.label.dtype == np.float32
 
 
+def _write_scipy_csr(path, n, src, dst):
+    """scipy.sparse.save_npz CSR layout, written without scipy."""
+    order = np.argsort(src, kind="stable")
+    indices = dst[order].astype(np.int64)
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.add.at(indptr[1:], src, 1)
+    indptr = np.cumsum(indptr)
+    np.savez(path, format=np.bytes_("csr"), shape=np.array([n, n]),
+             data=np.ones(len(src)), indices=indices, indptr=indptr)
+
+
+def test_reddit_disk_reader(tmp_path):
+    """load_data('reddit') without dgl reads DGL's on-disk npz layout."""
+    rng = np.random.default_rng(3)
+    n, e = 40, 160
+    d = tmp_path / "reddit"
+    d.mkdir()
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    types = np.ones(n, dtype=np.int64)
+    types[20:30] = 2
+    types[30:] = 3
+    np.savez(d / "reddit_data.npz",
+             feature=rng.normal(size=(n, 6)).astype(np.float32),
+             label=rng.integers(0, 5, n), node_types=types)
+    _write_scipy_csr(d / "reddit_graph.npz", n, src, dst)
+    g, n_feat, n_class = load_data(Config(dataset="reddit",
+                                          data_path=str(tmp_path)))
+    assert g.n_nodes == n and n_feat == 6 and n_class == 5
+    assert g.train_mask.sum() == 20 and g.val_mask.sum() == 10
+    assert np.sum(g.src == g.dst) == n          # canonical self-loops
+
+
+def test_yelp_disk_reader(tmp_path):
+    """load_data('yelp') without dgl reads the GraphSAINT layout (+ scaling)."""
+    import json
+    rng = np.random.default_rng(4)
+    n, e, c = 30, 90, 4
+    d = tmp_path / "yelp"
+    d.mkdir()
+    _write_scipy_csr(d / "adj_full.npz", n, rng.integers(0, n, e),
+                     rng.integers(0, n, e))
+    np.save(d / "feats.npy", rng.normal(size=(n, 5)).astype(np.float32))
+    cmap = {str(i): (rng.random(c) < 0.4).astype(float).tolist()
+            for i in range(n)}
+    (d / "class_map.json").write_text(json.dumps(cmap))
+    ids = rng.permutation(n)
+    (d / "role.json").write_text(json.dumps(
+        {"tr": ids[:18].tolist(), "va": ids[18:24].tolist(),
+         "te": ids[24:].tolist()}))
+    g, n_feat, n_class = load_data(Config(dataset="yelp",
+                                          data_path=str(tmp_path)))
+    assert g.multilabel and g.label.shape == (n, c) and n_class == c
+    # standard scaling fit on train rows (reference helper/utils.py:54-57)
+    mu = g.feat[g.train_mask].mean(0)
+    assert np.abs(mu).max() < 1e-5
+
+
+def test_ogb_disk_reader_csv(tmp_path):
+    """load_data('ogbn-products') without ogb reads the csv.gz layout."""
+    import gzip
+    rng = np.random.default_rng(5)
+    n, e = 25, 70
+    d = tmp_path / "ogbn_products"
+    (d / "raw").mkdir(parents=True)
+    sd = d / "split" / "sales_ranking"
+    sd.mkdir(parents=True)
+
+    def wgz(path, arr, fmt):
+        with gzip.open(path, "wt") as f:
+            np.savetxt(f, arr, delimiter=",", fmt=fmt)
+
+    wgz(d / "raw" / "edge.csv.gz",
+        np.stack([rng.integers(0, n, e), rng.integers(0, n, e)], 1), "%d")
+    wgz(d / "raw" / "node-feat.csv.gz",
+        rng.normal(size=(n, 4)).astype(np.float32), "%.6f")
+    wgz(d / "raw" / "node-label.csv.gz",
+        rng.integers(0, 3, size=(n, 1)), "%d")
+    ids = rng.permutation(n)
+    wgz(sd / "train.csv.gz", ids[:15].reshape(-1, 1), "%d")
+    wgz(sd / "valid.csv.gz", ids[15:20].reshape(-1, 1), "%d")
+    wgz(sd / "test.csv.gz", ids[20:].reshape(-1, 1), "%d")
+    g, n_feat, n_class = load_data(Config(dataset="ogbn-products",
+                                          data_path=str(tmp_path)))
+    assert g.n_nodes == n and n_feat == 4 and n_class == 3
+    assert g.train_mask.sum() == 15
+
+
+def test_ogb_disk_reader_binary_nan_labels(tmp_path):
+    """papers100M binary layout: raw/data.npz + NaN labels -> -1 sentinel."""
+    rng = np.random.default_rng(6)
+    n, e = 20, 50
+    d = tmp_path / "ogbn_papers100M"
+    (d / "raw").mkdir(parents=True)
+    sd = d / "split" / "time"
+    sd.mkdir(parents=True)
+    np.savez(d / "raw" / "data.npz",
+             edge_index=np.stack([rng.integers(0, n, e),
+                                  rng.integers(0, n, e)]),
+             node_feat=rng.normal(size=(n, 4)).astype(np.float32),
+             num_nodes_list=np.array([n]))
+    lab = rng.integers(0, 3, n).astype(np.float64)
+    lab[10:] = np.nan                              # unlabeled tail
+    np.savez(d / "raw" / "node-label.npz", node_label=lab)
+    np.savez(sd / "train.npz", ids=np.arange(0, 6))
+    np.savez(sd / "valid.npz", ids=np.arange(6, 8))
+    np.savez(sd / "test.npz", ids=np.arange(8, 10))
+    g, n_feat, n_class = load_data(Config(dataset="ogbn-papers100m",
+                                          data_path=str(tmp_path)))
+    assert g.n_nodes == n and n_feat == 4
+    assert g.label.min() == -1 and g.label[g.train_mask].min() >= 0
+
+
 def test_load_ogb_via_stub(monkeypatch):
     """Install a stub ogb.nodeproppred module and run the real adapter."""
     rng = np.random.default_rng(2)
