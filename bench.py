@@ -41,11 +41,18 @@ def _features(label: np.ndarray, n_feat=602, n_class=41) -> np.ndarray:
         scale=1.0, size=(label.shape[0], n_feat))).astype(np.float32)
 
 
-def _cached_graph(n_nodes: int, avg_degree: int, cache_dir: str, log):
-    """Synthetic graph with npz edge cache (generation dominates cold runs)."""
-    from bnsgcn_tpu.data.graph import Graph, synthetic_graph
+def _cached_graph(n_nodes: int, avg_degree: int, cache_dir: str, log,
+                  kind: str = "uniform"):
+    """Synthetic graph with npz edge cache (generation dominates cold runs).
+
+    kind='dcsbm': Reddit-calibrated degree-corrected SBM (41 communities,
+    power-law degrees, edge homophily 0.78 — see
+    data/graph.reddit_like_graph); 'uniform': the structure-free power-law
+    graph (round-1 stand-in, kept as the no-locality worst case)."""
+    from bnsgcn_tpu.data.graph import Graph, reddit_like_graph, synthetic_graph
     os.makedirs(cache_dir, exist_ok=True)
-    path = os.path.join(cache_dir, f"synth_{n_nodes}_{avg_degree}.npz")
+    tag = "synth" if kind == "uniform" else "dcsbm"
+    path = os.path.join(cache_dir, f"{tag}_{n_nodes}_{avg_degree}.npz")
     if os.path.exists(path):
         log(f"loading cached graph {path}")
         z = np.load(path)
@@ -53,8 +60,12 @@ def _cached_graph(n_nodes: int, avg_degree: int, cache_dir: str, log):
         return Graph(n_nodes, z["src"].astype(np.int64), z["dst"].astype(np.int64),
                      _features(label), label, z["train"], z["val"], z["test"])
     t0 = time.time()
-    g = synthetic_graph(n_nodes=n_nodes, avg_degree=avg_degree, n_feat=602,
-                        n_class=41, seed=0, power_law=True)
+    if kind == "uniform":
+        g = synthetic_graph(n_nodes=n_nodes, avg_degree=avg_degree, n_feat=602,
+                            n_class=41, seed=0, power_law=True)
+    else:
+        g = reddit_like_graph(n_nodes=n_nodes, avg_degree=avg_degree,
+                              n_feat=8, seed=0)
     g.feat = _features(g.label)
     log(f"  graph generated in {time.time() - t0:.1f}s: {g.n_edges} edges")
     np.savez(path, src=g.src.astype(np.int32), dst=g.dst.astype(np.int32),
@@ -73,6 +84,10 @@ def main():
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--dtype", choices=["f32", "bf16"], default="bf16")
+    ap.add_argument("--graph", choices=["dcsbm", "uniform"], default="dcsbm",
+                    help="dcsbm: Reddit-calibrated clustered stand-in "
+                         "(default); uniform: structure-free worst case")
+    ap.add_argument("--spmm", choices=["hybrid", "ell"], default="hybrid")
     ap.add_argument("--cache-dir", type=str, default="./bench_cache")
     ap.add_argument("--json-only", action="store_true")
     args = ap.parse_args()
@@ -93,42 +108,69 @@ def main():
     n_nodes = max(int(232_965 * args.scale), 2000)
     log(f"workload: {n_nodes} nodes x mean degree {args.avg_degree} "
         f"(~{n_nodes * args.avg_degree / 1e6:.1f}M edges/chip), "
-        f"GraphSAGE {args.layers}x{args.hidden}, pp, dtype={args.dtype}")
-    g = _cached_graph(n_nodes, args.avg_degree, args.cache_dir, log)
+        f"GraphSAGE {args.layers}x{args.hidden}, pp, dtype={args.dtype}, "
+        f"graph={args.graph}, spmm={args.spmm}")
+    g = _cached_graph(n_nodes, args.avg_degree, args.cache_dir, log,
+                      kind=args.graph)
 
     t0 = time.time()
     pid = partition_graph(g, 1)
     art = build_artifacts(g, pid)
-    cfg = Config(model="graphsage", n_layers=args.layers, n_hidden=args.hidden,
-                 use_pp=True, dropout=0.5, lr=0.01, sampling_rate=0.1,
-                 n_feat=art.n_feat, n_class=art.n_class, n_train=art.n_train)
+    log(f"  artifacts in {time.time() - t0:.1f}s")
     sizes = (art.n_feat,) + (args.hidden,) * (args.layers - 1) + (art.n_class,)
     spec = ModelSpec("graphsage", sizes, norm="layer", dropout=0.5,
                      use_pp=True, train_size=art.n_train)
     mesh = make_parts_mesh(1)
-    fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
-    log(f"  artifacts + ELL layouts in {time.time() - t0:.1f}s")
-
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
-    blk_np = build_block_arrays(art, spec.model)
-    blk_np.update(fns.extra_blk)
-    for k in fns.drop_blk_keys:
-        blk_np.pop(k, None)
-    blk = place_blocks(blk_np, mesh)
-    tables_d = place_replicated(tables, mesh)
-    blk["feat"] = fns.precompute(blk, place_replicated(tables_full, mesh)).astype(dtype)
-
-    params, state = init_params(jax.random.key(0), spec, dtype=dtype)
-    params = place_replicated(params, mesh)
-    state = place_replicated(state, mesh)
-    _, _, opt = init_training(cfg, spec, mesh)
     skey, dkey = jax.random.key(0), jax.random.key(1)
 
-    log("compiling + warmup...")
-    t0 = time.time()
-    params, state, opt, loss = fns.train_step(params, state, opt, jnp.uint32(0),
-                                              blk, tables_d, skey, dkey)
-    log(f"  first step (compile) {time.time() - t0:.1f}s, loss={float(loss):.4f}")
+    def setup_and_compile(spmm):
+        """Layouts + device data + the first (compiling) train step — any
+        failure here on real hardware triggers the ELL fallback."""
+        t0 = time.time()
+        cfg = Config(model="graphsage", n_layers=args.layers,
+                     n_hidden=args.hidden, use_pp=True, dropout=0.5,
+                     lr=0.01, sampling_rate=0.1, spmm=spmm,
+                     n_feat=art.n_feat, n_class=art.n_class,
+                     n_train=art.n_train)
+        fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
+        if spmm == "hybrid":
+            from bnsgcn_tpu.ops.block_spmm import dense_edge_count
+            dc = dense_edge_count(fns.extra_blk)
+            log(f"  hybrid tiling: {dc / 1e6:.1f}M of "
+                f"{g.n_edges / 1e6:.1f}M edges in dense tiles "
+                f"({dc / g.n_edges:.0%})")
+        log(f"  {spmm} layouts in {time.time() - t0:.1f}s")
+        blk_np = build_block_arrays(art, spec.model)
+        blk_np.update(fns.extra_blk)
+        for k in fns.drop_blk_keys:
+            blk_np.pop(k, None)
+        blk = place_blocks(blk_np, mesh)
+        tables_d = place_replicated(tables, mesh)
+        blk["feat"] = fns.precompute(
+            blk, place_replicated(tables_full, mesh)).astype(dtype)
+        params, state = init_params(jax.random.key(0), spec, dtype=dtype)
+        params = place_replicated(params, mesh)
+        state = place_replicated(state, mesh)
+        _, _, opt = init_training(cfg, spec, mesh)
+        log("compiling + warmup...")
+        t0 = time.time()
+        params, state, opt, loss = fns.train_step(
+            params, state, opt, jnp.uint32(0), blk, tables_d, skey, dkey)
+        log(f"  first step (compile) {time.time() - t0:.1f}s, "
+            f"loss={float(loss):.4f}")
+        return fns, blk, tables_d, params, state, opt, loss
+
+    built = None
+    for spmm in ([args.spmm, "ell"] if args.spmm == "hybrid" else [args.spmm]):
+        try:
+            built = setup_and_compile(spmm)
+            break
+        except Exception as ex:          # pragma: no cover - fallback path
+            log(f"  spmm={spmm} failed ({type(ex).__name__}: {ex}); "
+                f"falling back")
+    assert built is not None, "no SpMM variant built"
+    fns, blk, tables_d, params, state, opt, loss = built
 
     # chain CHUNK epochs between host syncs: per-dispatch host/tunnel latency
     # (~50ms on a tunneled chip) amortizes out of the per-epoch number, which

@@ -65,7 +65,8 @@ class Config:
     # --- TPU-specific knobs (no reference equivalent) ---
     dtype: str = "float32"              # compute dtype: 'float32' | 'bfloat16'
     edge_chunk: int = 0                 # >0: aggregate edges in chunks of this size (bounds HBM)
-    spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'segment'
+    spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'hybrid'
+                                        # (dense int8 MXU tiles + ELL residual) | 'segment'
     use_pallas: bool = False            # use Pallas aggregation kernels where available
     profile_dir: str = ""               # write a jax.profiler trace of a few epochs here
     remat: bool = False                 # rematerialize each layer in backward (saves HBM,
@@ -149,7 +150,8 @@ def create_parser() -> argparse.ArgumentParser:
     p.set_defaults(eval=True)
     # TPU-specific
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
-    p.add_argument("--spmm", type=str, default="ell", choices=["ell", "segment"])
+    p.add_argument("--spmm", type=str, default="ell",
+                   choices=["ell", "hybrid", "segment"])
     both("profile-dir", type=str, default="")
     p.add_argument("--remat", action="store_true")
     both("eval-device", type=str, default="host", choices=["host", "mesh"])

@@ -156,6 +156,66 @@ def synthetic_graph(n_nodes=200, avg_degree=8, n_feat=16, n_class=5,
     return g.canonicalize()
 
 
+def reddit_like_graph(n_nodes=232_965, avg_degree=492, n_class=41,
+                      n_feat=602, homophily=0.78, seed=0) -> Graph:
+    """Degree-corrected SBM calibrated to Reddit's shape statistics.
+
+    Real Reddit (the reference's flagship dataset, helper/utils.py:40-41) is
+    232,965 posts in 41 subreddit communities, ~114.6M directed edges (mean
+    degree ~492), and STRONGLY clustered — a GraphSAGE reaching 97.2% test
+    accuracy (reference README.md:101) requires high label homophily; the
+    commonly reported edge homophily for Reddit is ~0.78, which is the
+    default here. A uniform random graph (synthetic_graph) has none of this
+    structure and is an adversarial worst case no real dataset in the
+    reference's suite resembles.
+
+    Model: community sizes ~ Zipf; per-node popularity w ~ (local rank)^-0.5
+    (power-law degrees); each edge picks its source from the global
+    popularity law; with prob `homophily` the destination comes from the
+    SOURCE's community popularity law, else from the global law. Labels are
+    the communities; features are label-correlated Gaussians. All sampling
+    is inverse-transform (u^2 trick), O(E) vectorized.
+    """
+    rng = np.random.default_rng(seed)
+    # Zipf-ish community sizes, largest first, each >= 32 nodes; small graphs
+    # get fewer communities instead of a negative balancing remainder
+    n_class = max(min(n_class, n_nodes // 64), 1)
+    raw = 1.0 / np.arange(1, n_class + 1) ** 0.9
+    sizes = np.maximum((raw / raw.sum() * n_nodes).astype(np.int64), 32)
+    while sizes.sum() > n_nodes:          # trim the floor-induced excess from
+        sizes[0] -= min(sizes[0] - 32, sizes.sum() - n_nodes)  # the largest
+        if sizes[0] <= 32 and sizes.sum() > n_nodes:
+            sizes = sizes[:-1]
+    sizes[0] += n_nodes - sizes.sum()
+    off = np.concatenate([[0], np.cumsum(sizes)])
+    label = np.repeat(np.arange(n_class, dtype=np.int64), sizes)
+
+    n_edges = n_nodes * avg_degree
+    # popularity mass of community c: sum_j (j+1)^-0.5 ~ 2*sqrt(n_c)
+    mass = 2.0 * np.sqrt(sizes.astype(np.float64))
+    cdf = np.cumsum(mass / mass.sum())
+
+    def global_draw(k):
+        c = np.searchsorted(cdf, rng.random(k))
+        return off[c] + (sizes[c] * rng.random(k) ** 2).astype(np.int64)
+
+    src = global_draw(n_edges)
+    intra = rng.random(n_edges) < homophily
+    c_src = label[src]
+    dst = np.empty(n_edges, dtype=np.int64)
+    n_in = int(intra.sum())
+    dst[intra] = off[c_src[intra]] + (
+        sizes[c_src[intra]] * rng.random(n_in) ** 2).astype(np.int64)
+    dst[~intra] = global_draw(n_edges - n_in)
+
+    centers = rng.normal(size=(n_class, n_feat)).astype(np.float32)
+    feat = (centers[label] + rng.normal(
+        scale=1.0, size=(n_nodes, n_feat)).astype(np.float32))
+    train, val, test = _random_masks(rng, n_nodes)
+    g = Graph(n_nodes, src, dst, feat, label, train, val, test)
+    return g.canonicalize()
+
+
 def sbm_graph(n_nodes=400, n_class=4, n_feat=16, p_in=0.05, p_out=0.002,
               seed=0) -> Graph:
     """Stochastic-block-model graph: communities align with labels, so a GNN

@@ -1,0 +1,119 @@
+"""Hybrid block-dense + ELL SpMM == plain ELL SpMM == dense oracle
+(forward and gradients), on clustered and uniform graphs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bnsgcn_tpu.data.artifacts import build_artifacts
+from bnsgcn_tpu.data.graph import sbm_graph, synthetic_graph
+from bnsgcn_tpu.data.partitioner import partition_graph
+from bnsgcn_tpu.ops.block_spmm import (build_block_layouts, cluster_order,
+                                       dense_edge_count, make_block_spmm)
+from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
+
+
+def _hybrid_for(art, occupancy_min):
+    P = art.n_parts
+    perms_i, perms_e = [], []
+    for p in range(P):
+        pi, pe = cluster_order(art.src[p], art.dst[p], art.pad_inner,
+                               art.n_ext, target=64)
+        perms_i.append(pi)
+        perms_e.append(pe)
+    fwd, bwd, ell_pair, arrays = build_block_layouts(
+        art.src, art.dst, art.pad_inner, art.n_ext,
+        np.stack(perms_i), np.stack(perms_e), occupancy_min=occupancy_min)
+    return fwd, bwd, ell_pair, arrays
+
+
+def _dense_oracle(art, p, h_ext):
+    out = np.zeros((art.pad_inner, h_ext.shape[1]))
+    real = art.dst[p] < art.pad_inner
+    np.add.at(out, art.dst[p][real], np.asarray(h_ext)[art.src[p][real]])
+    return out
+
+
+@pytest.mark.parametrize("graph,occ", [("sbm", 4), ("uniform", 4),
+                                       ("sbm", 10**9)])
+def test_hybrid_matches_oracle_and_grads(graph, occ):
+    """occ=4: most edges densify on the clustered graph; occ=huge: pure-ELL
+    degeneration — all must equal the dense oracle exactly."""
+    if graph == "sbm":
+        g = sbm_graph(n_nodes=300, n_class=5, n_feat=6, p_in=0.15,
+                      p_out=0.003, seed=61)
+    else:
+        g = synthetic_graph(n_nodes=300, avg_degree=8, n_feat=6, seed=62)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=3))
+    fwd, bwd, ell_pair, arrays = _hybrid_for(art, occ)
+    spmm = make_block_spmm(fwd, bwd, ell_pair)
+    if graph == "sbm" and occ == 4:
+        assert dense_edge_count(arrays, 0) > 0, "no tiles densified"
+    rng = np.random.default_rng(0)
+    H = 7
+    for p in range(art.n_parts):
+        h = jnp.asarray(rng.normal(size=(art.n_ext, H)), jnp.float32)
+        arr_p = {k: jnp.asarray(v[p]) for k, v in arrays.items()}
+        out = np.asarray(spmm(arr_p, h))
+        np.testing.assert_allclose(out, _dense_oracle(art, p, h),
+                                   rtol=1e-4, atol=1e-4)
+        # gradients: d/dh sum(out * cot) == A^T cot
+        cot = rng.normal(size=out.shape).astype(np.float32)
+        gfn = jax.grad(lambda hh: jnp.sum(spmm(arr_p, hh) * cot))
+        d_h = np.asarray(gfn(h))
+        d_ref = np.zeros((art.n_ext, H))
+        real = art.dst[p] < art.pad_inner
+        np.add.at(d_ref, art.src[p][real], cot[art.dst[p][real]])
+        np.testing.assert_allclose(d_h, d_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_hybrid_equals_pure_ell():
+    g = sbm_graph(n_nodes=240, n_class=4, n_feat=6, p_in=0.12, p_out=0.004,
+                  seed=63)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=4))
+    fwd_h, bwd_h, ell_pair, arrays_h = _hybrid_for(art, 4)
+    hybrid = make_block_spmm(fwd_h, bwd_h, ell_pair)
+    f_spec, b_spec, ell_arrays = build_layouts(art.src, art.dst,
+                                               art.pad_inner, art.n_ext)
+    ell = make_ell_spmm(f_spec, b_spec, len(f_spec.widths),
+                        len(b_spec.widths))
+    rng = np.random.default_rng(1)
+    h = jnp.asarray(rng.normal(size=(art.n_ext, 5)), jnp.float32)
+    a_h = {k: jnp.asarray(v[0]) for k, v in arrays_h.items()}
+    a_e = {k: jnp.asarray(v[0]) for k, v in ell_arrays.items()}
+    np.testing.assert_allclose(np.asarray(hybrid(a_h, h)),
+                               np.asarray(ell(a_e, h)), rtol=1e-4, atol=1e-4)
+
+
+def test_multiplicity_overflow_rides_residual():
+    """>127 duplicate edges of one (u,v) pair exceed int8 tile headroom; the
+    excess must ride the ELL residual so hybrid == oracle exactly."""
+    g = sbm_graph(n_nodes=200, n_class=3, n_feat=5, p_in=0.2, p_out=0.01,
+                  seed=65)
+    g.src = np.concatenate([g.src, np.full(300, 7, dtype=np.int64)])
+    g.dst = np.concatenate([g.dst, np.full(300, 9, dtype=np.int64)])
+    art = build_artifacts(g, np.zeros(g.n_nodes, dtype=np.int32))
+    fwd, bwd, ell_pair, arrays = _hybrid_for(art, 4)
+    assert int(arrays["blk_tiles_fwd"].max()) == 127, "no tile saturated"
+    spmm = make_block_spmm(fwd, bwd, ell_pair)
+    rng = np.random.default_rng(2)
+    h = jnp.asarray(rng.normal(size=(art.n_ext, 5)), jnp.float32)
+    arr0 = {k: jnp.asarray(v[0]) for k, v in arrays.items()}
+    np.testing.assert_allclose(np.asarray(spmm(arr0, h)),
+                               _dense_oracle(art, 0, h), rtol=1e-4, atol=1e-4)
+    cot = rng.normal(size=(art.pad_inner, 5)).astype(np.float32)
+    d_h = np.asarray(jax.grad(lambda hh: jnp.sum(spmm(arr0, hh) * cot))(h))
+    d_ref = np.zeros((art.n_ext, 5))
+    real = art.dst[0] < art.pad_inner
+    np.add.at(d_ref, art.src[0][real], cot[art.dst[0][real]])
+    np.testing.assert_allclose(d_h, d_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_cluster_order_is_permutation():
+    g = sbm_graph(n_nodes=200, n_class=4, n_feat=4, seed=64)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=5))
+    pi, pe = cluster_order(art.src[0], art.dst[0], art.pad_inner, art.n_ext)
+    assert sorted(pi.tolist()) == list(range(art.pad_inner))
+    assert sorted(pe.tolist()) == list(range(art.n_ext))
+    np.testing.assert_array_equal(pe[:art.pad_inner], pi)

@@ -7,8 +7,14 @@ pipeline: vectorized streaming build, bf16 feature storage, partial loads.)
 
 Usage:
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8 \
+    --xla_cpu_collective_call_warn_stuck_timeout_seconds=600 \
+    --xla_cpu_collective_call_terminate_timeout_seconds=3600" \
   python tools/scale_proof.py [--nodes 12500000] [--deg 8] [--parts 8]
+
+The collective-timeout flags matter: XLA:CPU's rendezvous defaults to a 40s
+hard kill, and 8 virtual devices serialized on few cores legitimately take
+longer than that per step at this scale.
 """
 
 from __future__ import annotations
