@@ -207,10 +207,26 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
     ell_fwd, ell_bwd = ell_pair
     ell = make_ell_spmm(ell_fwd, ell_bwd, len(ell_fwd.widths),
                         len(ell_bwd.widths), use_pallas=use_pallas)
+    # transposed residual operator for the backward: same tables with the
+    # fwd/bwd roles swapped (a nested vjp at a dummy point would record an
+    # unvarying primal and trip shard_map's varying-axes check)
+    ell_t = make_ell_spmm(ell_bwd, ell_fwd, len(ell_bwd.widths),
+                          len(ell_fwd.widths), use_pallas=use_pallas)
 
     def _res_arrays(arrays):
         return {k[len("res_"):]: v for k, v in arrays.items()
                 if k.startswith("res_")}
+
+    def _swap_dirs(arrays):
+        out = {}
+        for k, v in arrays.items():
+            if k.startswith("fwd_"):
+                out["bwd_" + k[4:]] = v
+            elif k.startswith("bwd_"):
+                out["fwd_" + k[4:]] = v
+            else:
+                out[k] = v
+        return out
 
     @jax.custom_vjp
     def spmm(arrays, h_ext):
@@ -229,9 +245,7 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
                                arrays["blk_rowb_bwd"], arrays["blk_colb_bwd"],
                                arrays["blk_perm_inner"], arrays["blk_perm_ext"],
                                g)
-        _, ell_vjp = jax.vjp(lambda h: ell(_res_arrays(arrays), h),
-                             jnp.zeros((fwd.n_src, g.shape[1]), g.dtype))
-        (d_res,) = ell_vjp(g)
+        d_res = ell_t(_swap_dirs(_res_arrays(arrays)), g)
         return None, (d_dense + d_res).astype(g.dtype)
 
     spmm.defvjp(fwd_rule, bwd_rule)

@@ -110,6 +110,51 @@ def test_multiplicity_overflow_rides_residual():
     np.testing.assert_allclose(d_h, d_ref, rtol=1e-4, atol=1e-4)
 
 
+def test_hybrid_train_step_matches_ell():
+    """--spmm hybrid inside the sharded train step (custom VJP under
+    shard_map's varying-axes checks) == --spmm ell, losses and params."""
+    import jax.numpy as jnp
+    from bnsgcn_tpu.config import Config
+    from bnsgcn_tpu.models.gnn import ModelSpec, init_params
+    from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+    from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns,
+                                    init_training, place_blocks,
+                                    place_replicated)
+
+    g = sbm_graph(n_nodes=240, n_class=4, n_feat=8, p_in=0.1, p_out=0.005,
+                  seed=66)
+    spec = ModelSpec("graphsage", (8, 16, 4), norm="layer", dropout=0.0,
+                     use_pp=True, train_size=g.n_train)
+    params0, state0 = init_params(jax.random.key(6), spec)
+    params_np = jax.tree.map(np.asarray, params0)
+    mesh = make_parts_mesh(4)
+    art = build_artifacts(g, partition_graph(g, 4, method="random", seed=7))
+    results = {}
+    for spmm in ("hybrid", "ell"):
+        cfg = Config(model="graphsage", dropout=0.0, use_pp=True,
+                     norm="layer", n_train=g.n_train, lr=0.01,
+                     sampling_rate=0.5, spmm=spmm)
+        fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
+        blk_np = build_block_arrays(art, "graphsage")
+        blk_np.update(fns.extra_blk)
+        for k in fns.drop_blk_keys:
+            blk_np.pop(k, None)
+        blk = place_blocks(blk_np, mesh)
+        tb = place_replicated(tables, mesh)
+        blk["feat"] = fns.precompute(blk, place_replicated(tables_full, mesh))
+        p = place_replicated(params_np, mesh)
+        s = place_replicated(state0, mesh)
+        _, _, opt = init_training(cfg, spec, mesh)
+        for e in range(3):
+            p, s, opt, loss = fns.train_step(p, s, opt, jnp.uint32(e), blk, tb,
+                                             jax.random.key(0), jax.random.key(1))
+        results[spmm] = (float(loss), jax.tree.map(np.asarray, jax.device_get(p)))
+    assert abs(results["hybrid"][0] - results["ell"][0]) < 1e-5
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                         atol=1e-5),
+                 results["hybrid"][1], results["ell"][1])
+
+
 def test_cluster_order_is_permutation():
     g = sbm_graph(n_nodes=200, n_class=4, n_feat=4, seed=64)
     art = build_artifacts(g, partition_graph(g, 2, method="random", seed=5))
