@@ -42,8 +42,8 @@ import numpy as np
 
 from bnsgcn_tpu.ops.ell import EllSpec, build_layouts, make_ell_spmm
 
-TR = 128          # dst rows per fwd dense tile
-TC = 512          # src cols per fwd dense tile (slab gather granularity)
+TR = 512          # dst rows per dense tile (square: transposes keep shape,
+TC = 512          # and per-edge slab/output overhead beats narrow tiles)
 
 
 @dataclass(frozen=True)
@@ -58,51 +58,79 @@ class BlockSpec:
 
 
 def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
-                 occupancy_min):
-    """Dense tiles over cluster-ordered (rows x cols); returns
-    (tiles int8 [B,TR,TC], row_blk, col_blk, residual_edge_mask)."""
+                 occupancy_min, tile_budget_bytes=2 << 30):
+    """Dense tiles over cluster-ordered (rows x cols); fully vectorized.
+
+    A tile densifies only if it carries >= occupancy_min edges (an int8
+    512x512 tile costs TR*TC = 256KB of HBM reads per pass plus its slab
+    and output shares — byte break-even vs 512B-row gathers lands around
+    ~512 edges, the default threshold) AND the total dense storage stays
+    under tile_budget_bytes (highest-count tiles win; ties trimmed last).
+    Returns (tiles int8 [B,TR,TC] sorted by row_blk, row_blk, col_blk,
+    residual_edge_mask, extra_rows, extra_cols) — the extras are >127
+    multiplicity overflow in PERMUTED coordinates. Accumulation runs in
+    ~1 GB int32 chunks so peak host memory stays near the budget itself."""
     n_cb = (n_src + TC - 1) // TC
     pr = perm_rows[rows]
     pc = perm_cols[cols]
     tile_id = (pr // TR).astype(np.int64) * n_cb + pc // TC
-    order = np.argsort(tile_id, kind="stable")
-    tid_sorted = tile_id[order]
-    uniq, start = np.unique(tid_sorted, return_index=True)
-    counts = np.diff(np.concatenate([start, [len(tid_sorted)]]))
+    uniq, inv, counts = np.unique(tile_id, return_inverse=True,
+                                  return_counts=True)
+    max_tiles = max(int(tile_budget_bytes // (TR * TC)), 1)
     dense_sel = counts >= occupancy_min
+    if int(dense_sel.sum()) > max_tiles:
+        # keep every tile strictly above the cut, trim only among ties
+        thresh = np.sort(counts[dense_sel])[-max_tiles]
+        above = counts > thresh
+        ties = np.nonzero(dense_sel & (counts == thresh))[0]
+        dense_sel = above
+        dense_sel[ties[:max_tiles - int(above.sum())]] = True
+    B = int(dense_sel.sum())
+    if B == 0:
+        return (np.zeros((0, TR, TC), np.int8), np.zeros(0, np.int32),
+                np.zeros(0, np.int32), np.ones(len(rows), dtype=bool),
+                np.zeros(0, np.int64), np.zeros(0, np.int64))
 
-    tiles, row_blk, col_blk = [], [], []
-    resid_mask = np.ones(len(rows), dtype=bool)
-    extra_rows, extra_cols = [], []
-    for t_idx in np.nonzero(dense_sel)[0]:
-        s, c = start[t_idx], counts[t_idx]
-        e_sel = order[s:s + c]
-        resid_mask[e_sel] = False
-        rb, cb = int(uniq[t_idx] // n_cb), int(uniq[t_idx] % n_cb)
-        tile = np.zeros((TR, TC), dtype=np.int64)
-        np.add.at(tile, (pr[e_sel] - rb * TR, pc[e_sel] - cb * TC), 1)
-        over = tile > 127                 # int8 headroom: excess multiplicity
-        if over.any():                    # of hub pairs rides the residual
-            orr, occ = np.nonzero(over)
-            rep = (tile[orr, occ] - 127).astype(np.int64)
-            extra_rows.append(np.repeat(orr + rb * TR, rep))  # PERMUTED pos
-            extra_cols.append(np.repeat(occ + cb * TC, rep))
-            tile = np.minimum(tile, 127)
-        tiles.append(tile.astype(np.int8))
-        row_blk.append(rb)
-        col_blk.append(cb)
-    tiles = (np.stack(tiles) if tiles
-             else np.zeros((0, TR, TC), dtype=np.int8))
-    return (tiles, np.asarray(row_blk, np.int32),
-            np.asarray(col_blk, np.int32), resid_mask,
-            (np.concatenate(extra_rows) if extra_rows
+    rank = np.full(len(uniq), -1, dtype=np.int64)
+    rank[np.nonzero(dense_sel)[0]] = np.arange(B)        # uniq sorted => rb-major
+    e_rank = rank[inv]
+    m = e_rank >= 0
+    resid_mask = ~m
+    sel_ids = uniq[dense_sel]
+    row_blk = (sel_ids // n_cb).astype(np.int32)
+    col_blk = (sel_ids % n_cb).astype(np.int32)
+
+    order2 = np.argsort(e_rank[m], kind="stable")
+    er_s = e_rank[m][order2]
+    prm_s = (pr[m] % TR)[order2]
+    pcm_s = (pc[m] % TC)[order2]
+    tiles8 = np.zeros((B, TR, TC), dtype=np.int8)
+    extra_rows_l, extra_cols_l = [], []
+    chunk = max(1, (1 << 30) // (TR * TC * 4))           # ~1 GB int32
+    for c0 in range(0, B, chunk):
+        c1 = min(c0 + chunk, B)
+        lo, hi = np.searchsorted(er_s, [c0, c1])
+        t32 = np.zeros((c1 - c0, TR, TC), dtype=np.int32)
+        np.add.at(t32, (er_s[lo:hi] - c0, prm_s[lo:hi], pcm_s[lo:hi]), 1)
+        ob, orr, occ = np.nonzero(t32 > 127)
+        if len(ob):
+            rep = (t32[ob, orr, occ] - 127).astype(np.int64)
+            extra_rows_l.append(np.repeat(
+                orr + row_blk[ob + c0].astype(np.int64) * TR, rep))
+            extra_cols_l.append(np.repeat(
+                occ + col_blk[ob + c0].astype(np.int64) * TC, rep))
+            np.minimum(t32, 127, out=t32)
+        tiles8[c0:c1] = t32.astype(np.int8)
+    return (tiles8, row_blk, col_blk, resid_mask,
+            (np.concatenate(extra_rows_l) if extra_rows_l
              else np.zeros(0, np.int64)),
-            (np.concatenate(extra_cols) if extra_cols
+            (np.concatenate(extra_cols_l) if extra_cols_l
              else np.zeros(0, np.int64)))
 
 
 def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
-                        perm_ext, occupancy_min=48):
+                        perm_ext, occupancy_min=512,
+                        tile_budget_bytes=2 << 30):
     """Hybrid layout for all local parts. perm_inner [P, n_dst] /
     perm_ext [P, n_src_ext]: cluster position per original row (the inner
     prefix of perm_ext must equal perm_inner).
@@ -115,7 +143,8 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
         real = dst_all[p] < n_dst
         s, d = src_all[p][real], dst_all[p][real]
         tiles, rb, cb, resid, xr, xc = _build_tiles(
-            perm_inner[p], perm_ext[p], n_dst, n_src_ext, d, s, occupancy_min)
+            perm_inner[p], perm_ext[p], n_dst, n_src_ext, d, s, occupancy_min,
+            tile_budget_bytes)
         per_part.append((tiles, rb, cb))
         # excess-multiplicity edges come back in PERMUTED coordinates —
         # map to original ids for the residual ELL

@@ -1,0 +1,30 @@
+"""Dense-tile coverage + layout build cost on the full-scale dcsbm bench graph."""
+import os, sys, time
+import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.getcwd())
+from bench import _cached_graph
+from bnsgcn_tpu.data.artifacts import build_artifacts
+from bnsgcn_tpu.data.partitioner import partition_graph
+from bnsgcn_tpu.ops.block_spmm import (TC, TR, build_block_layouts,
+                                       cluster_order, dense_edge_count)
+
+log = lambda *a: print(*a, flush=True)
+g = _cached_graph(116482, 492, "./bench_cache", log, kind="dcsbm")
+t0 = time.time()
+art = build_artifacts(g, partition_graph(g, 1))
+log(f"artifacts {time.time()-t0:.0f}s")
+t0 = time.time()
+pi, pe = cluster_order(art.src[0], art.dst[0], art.pad_inner, art.n_ext)
+log(f"cluster_order {time.time()-t0:.0f}s")
+t0 = time.time()
+fwd, bwd, ell_pair, arrays = build_block_layouts(
+    art.src, art.dst, art.pad_inner, art.n_ext, pi[None], pe[None])
+dc = dense_edge_count(arrays)
+B = arrays["blk_tiles_fwd"].shape[1]
+log(f"tiling {time.time()-t0:.0f}s: {dc/1e6:.1f}M / {g.n_edges/1e6:.1f}M edges dense "
+    f"({dc/g.n_edges:.1%}), {B} tiles ({B*TR*TC/1e9:.2f} GB int8), "
+    f"avg occupancy {dc/max(B,1)/(TR*TC):.1%}")
+res_rows = sum(arrays[f"res_fwd_idx_{k}"].shape[1] * w
+               for k, w in enumerate(ell_pair[0].widths))
+log(f"residual ELL padded gathers ~{res_rows/1e6:.1f}M")
