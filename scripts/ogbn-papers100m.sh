@@ -15,7 +15,11 @@ NODE_RANK=${NODE_RANK:-0}
 MASTER=${MASTER:-127.0.0.1}
 
 if [ -n "$PARTITION" ]; then
-  exec python -m bnsgcn_tpu.partition_cli --dataset ogbn-papers100m --n-partitions ${P:-64}
+  # streaming builder (one part resident at a time, vectorized passes) with
+  # bf16 feature storage: 111M x 128 feats land on disk at half the bytes.
+  # Proven at 1e8-edge scale by tools/scale_proof.py (see PARITY.md).
+  exec python -m bnsgcn_tpu.partition_cli --dataset ogbn-papers100m \
+    --n-partitions ${P:-64} --streaming-artifacts always --feat-storage bfloat16
 fi
 
 python -m bnsgcn_tpu.main \
@@ -30,6 +34,8 @@ python -m bnsgcn_tpu.main \
   --n-epochs 200 \
   --log-every 10 \
   --use-pp \
+  --dtype bfloat16 \
+  --halo-wire fp8 \
   --eval-device mesh \
   --n-nodes $NODES --node-rank $NODE_RANK --master-addr $MASTER \
   --skip-partition \

@@ -51,6 +51,7 @@ def main():
                     help="measured ELL padding factor (gathers per edge)")
     ap.add_argument("--spmm-passes", type=int, default=6,
                     help="SpMM passes per epoch (3 graph layers x fwd+bwd)")
+    ap.add_argument("--graph", choices=["dcsbm", "uniform"], default="dcsbm")
     ap.add_argument("--cache-dir", type=str, default="./bench_cache")
     args = ap.parse_args()
 
@@ -61,7 +62,7 @@ def main():
 
     n_nodes = max(int(232_965 * args.scale), 2000)
     log = lambda *a: print(*a, file=sys.stderr, flush=True)
-    g = _cached_graph(n_nodes, 492, args.cache_dir, log)
+    g = _cached_graph(n_nodes, 492, args.cache_dir, log, kind=args.graph)
     n_ex = args.layers - 2  # hidden-width exchanges per fwd pass (pp drops L0)
 
     print("| P | edges/chip | max boundary/pair | wire MB/epoch/chip "
@@ -74,7 +75,11 @@ def main():
         if P == 1:
             pid = np.zeros(g.n_nodes, dtype=np.int32)
         else:
-            pid = partition_graph(g, P, method="metis", obj="vol", seed=0)
+            from bnsgcn_tpu.native import native_partition
+            pid = native_partition(g, P, obj="vol", seed=0,
+                                   refine_passes=4, n_seeds=args.seeds)
+            if pid is None:
+                pid = partition_graph(g, P, method="random", seed=0)
         # boundary sizes n_b[p, j]
         src_o, dst_o = pid[g.src], pid[g.dst]
         cross = src_o != dst_o
