@@ -124,13 +124,15 @@ def main():
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
     skey, dkey = jax.random.key(0), jax.random.key(1)
 
-    def setup_and_compile(spmm):
+    def setup_and_compile(variant):
         """Layouts + device data + the first (compiling) train step — any
         failure here on real hardware triggers the ELL fallback."""
         t0 = time.time()
+        spmm, use_pallas = variant
         cfg = Config(model="graphsage", n_layers=args.layers,
                      n_hidden=args.hidden, use_pp=True, dropout=0.5,
                      lr=0.01, sampling_rate=0.1, spmm=spmm,
+                     use_pallas=use_pallas,
                      n_feat=art.n_feat, n_class=art.n_class,
                      n_train=art.n_train)
         fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
@@ -185,19 +187,35 @@ def main():
             min_t = min(min_t, dt / n)
         return total_t / args.epochs, min_t, loss
 
-    candidates = ["hybrid", "ell"] if args.spmm == "hybrid" else [args.spmm]
-    best = None                       # (epoch_t, min_t, loss, spmm)
-    for spmm in candidates:
+    # ell runs FIRST as the trusted reference; hybrid variants must agree
+    # with its first-epochs loss (guards a silently-miscompiling kernel from
+    # ever winning the headline number)
+    if args.spmm == "hybrid":
+        candidates = [("ell", False), ("hybrid", False)]
+        if jax.default_backend() == "tpu":   # pallas kernel is TPU-only
+            candidates.append(("hybrid", True))
+    else:
+        candidates = [(args.spmm, False)]
+    best, ref_loss = None, None
+    for variant in candidates:
+        name = variant[0] + ("+pallas" if variant[1] else "")
         try:
-            built = setup_and_compile(spmm)
+            built = setup_and_compile(variant)
+            et, mt, loss = measure(built)
         except Exception as ex:       # pragma: no cover - fallback path
-            log(f"  spmm={spmm} failed ({type(ex).__name__}: {ex}); "
+            log(f"  spmm={name} failed ({type(ex).__name__}: {ex}); "
                 f"falling back")
             continue
-        et, mt, loss = measure(built)
-        log(f"  spmm={spmm}: {et:.4f}s/epoch")
+        lf = float(loss)
+        log(f"  spmm={name}: {et:.4f}s/epoch loss={lf:.4f}")
+        if ref_loss is None:
+            ref_loss = lf
+        elif not (abs(lf - ref_loss) <= 0.02 * abs(ref_loss) + 1e-3):
+            log(f"  spmm={name} loss {lf:.4f} != reference {ref_loss:.4f}; "
+                f"DISCARDED")
+            continue
         if best is None or et < best[0]:
-            best = (et, mt, loss, spmm, built[-1])
+            best = (et, mt, loss, name, built[-1])
         del built
     assert best is not None, "no SpMM variant built"
     epoch_t, min_t, loss, spmm_used, hbm = best

@@ -209,8 +209,9 @@ def dense_edge_count(arrays, part: int = 0) -> int:
     return int(arrays["blk_tiles_fwd"][part].astype(np.int64).sum())
 
 
-def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h):
-    """Dense-tile aggregation; returns [n_rows, H] in ORIGINAL row order."""
+def build_x_slabs(spec: BlockSpec, perm_src, h):
+    """X in cluster order, sliced into [n_cb, col_tile, H] slabs — shared by
+    the XLA and Pallas dense paths so pad/permutation handling cannot drift."""
     H = h.shape[1]
     n_cb = (spec.n_src + spec.col_tile - 1) // spec.col_tile
     pad_src = n_cb * spec.col_tile
@@ -218,7 +219,13 @@ def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h):
     inv_src = jnp.full((pad_src,), spec.n_src, jnp.int32).at[perm_src].set(
         jnp.arange(spec.n_src, dtype=jnp.int32))
     hp = jnp.concatenate([h, jnp.zeros((1, H), h.dtype)], 0)
-    x_perm = hp[inv_src].reshape(n_cb, spec.col_tile, H)
+    return hp[inv_src].reshape(n_cb, spec.col_tile, H)
+
+
+def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h):
+    """Dense-tile aggregation; returns [n_rows, H] in ORIGINAL row order."""
+    H = h.shape[1]
+    x_perm = build_x_slabs(spec, perm_src, h)
     slabs = x_perm[colb]                                   # [B, TC, H]
     prod = jnp.einsum("brc,bch->brh", tiles.astype(h.dtype), slabs,
                       preferred_element_type=jnp.float32)  # [B, TR, H]
@@ -246,6 +253,18 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
         return {k[len("res_"):]: v for k, v in arrays.items()
                 if k.startswith("res_")}
 
+    def _dense(spec_d, arrays, tiles_key, rowb_key, colb_key, perm_src_key,
+               perm_out_key, h):
+        # Pallas fused grouped-matmul on TPU (use_pallas); XLA path elsewhere
+        if use_pallas and jax.default_backend() == "tpu":
+            from bnsgcn_tpu.ops.pallas_block import dense_apply_pallas
+            return dense_apply_pallas(
+                spec_d, arrays[tiles_key], arrays[rowb_key], arrays[colb_key],
+                arrays[perm_src_key], arrays[perm_out_key], h)
+        return _dense_apply(spec_d, arrays[tiles_key], arrays[rowb_key],
+                            arrays[colb_key], arrays[perm_src_key],
+                            arrays[perm_out_key], h)
+
     def _swap_dirs(arrays):
         out = {}
         for k, v in arrays.items():
@@ -259,10 +278,9 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
 
     @jax.custom_vjp
     def spmm(arrays, h_ext):
-        dense = _dense_apply(fwd, arrays["blk_tiles_fwd"],
-                             arrays["blk_rowb_fwd"], arrays["blk_colb_fwd"],
-                             arrays["blk_perm_ext"], arrays["blk_perm_inner"],
-                             h_ext)
+        dense = _dense(fwd, arrays, "blk_tiles_fwd", "blk_rowb_fwd",
+                       "blk_colb_fwd", "blk_perm_ext", "blk_perm_inner",
+                       h_ext)
         return dense + ell(_res_arrays(arrays), h_ext)
 
     def fwd_rule(arrays, h_ext):
@@ -270,10 +288,8 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
 
     def bwd_rule(res, g):
         (arrays,) = res
-        d_dense = _dense_apply(bwd, arrays["blk_tiles_bwd"],
-                               arrays["blk_rowb_bwd"], arrays["blk_colb_bwd"],
-                               arrays["blk_perm_inner"], arrays["blk_perm_ext"],
-                               g)
+        d_dense = _dense(bwd, arrays, "blk_tiles_bwd", "blk_rowb_bwd",
+                         "blk_colb_bwd", "blk_perm_inner", "blk_perm_ext", g)
         d_res = ell_t(_swap_dirs(_res_arrays(arrays)), g)
         return None, (d_dense + d_res).astype(g.dtype)
 

@@ -1,0 +1,101 @@
+"""Pallas grouped-matmul kernel for the hybrid SpMM's dense tiles.
+
+The XLA formulation (ops/block_spmm._dense_apply) materializes the slab
+gather [B, TC, H] and the per-tile partial products [B, TR, H] f32 in HBM
+before the segment-sum. This kernel fuses all three: a standard block
+pipeline (NO manual DMA — this environment's remote compiler rejects
+make_async_copy kernels, see ops/pallas_spmm.py) over grid=(B,) where
+
+  * the adjacency tile [TR, TC] int8 streams in per step,
+  * the X slab block index comes from the scalar-prefetched colb table
+    (PrefetchScalarGridSpec — the megablocks/gmm pattern),
+  * the output block index comes from rowb; tiles are rowb-sorted, so
+    revisited output blocks stay resident and accumulate in VMEM, zeroed on
+    first visit.
+
+Per pass this reads tiles once + one slab per tile at pipeline DMA rates and
+writes each output row-block once — no [B, TR, H] partials, no segment-sum.
+
+Correctness is pinned against the XLA path in tests (interpret mode off-TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(rowb_ref, colb_ref, a_ref, x_ref, o_ref):
+    b = pl.program_id(0)
+    first = b == 0
+    changed = rowb_ref[b] != rowb_ref[jnp.maximum(b, 1) - 1]
+
+    @pl.when(jnp.logical_or(first, changed))
+    def _():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    a = a_ref[0].astype(x_ref.dtype)
+    o_ref[...] += jax.lax.dot_general(
+        a, x_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)[None]
+
+
+def pallas_tile_matmul(tiles: jax.Array, rowb: jax.Array, colb: jax.Array,
+                       x_slabs: jax.Array, n_row_blocks: int,
+                       interpret: bool = False) -> jax.Array:
+    """tiles [B, TR, TC] int8, rowb/colb [B] int32 (rowb sorted ascending,
+    pads = n_row_blocks), x_slabs [n_cb, TC, H] -> out [n_row_blocks+1, TR, H]
+    f32 (last block is the pad-tile trash; caller slices it off).
+
+    Row blocks NO tile maps to are never written by the kernel — on hardware
+    Pallas out buffers are uninitialized, so the CALLER must mask them
+    (dense_apply_pallas does, via the statically-known visited set)."""
+    B, TR, TC = tiles.shape
+    H = x_slabs.shape[-1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, TR, TC), lambda b, rowb, colb: (b, 0, 0)),
+            pl.BlockSpec((1, TC, H), lambda b, rowb, colb: (colb[b], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TR, H), lambda b, rowb, colb: (rowb[b], 0, 0)),
+    )
+    try:
+        # under shard_map with check_vma the out aval must carry the same
+        # varying-mesh-axes set as the input (see ops/pallas_spmm.py)
+        out_shape = jax.ShapeDtypeStruct((n_row_blocks + 1, TR, H),
+                                         jnp.float32,
+                                         vma=jax.typeof(x_slabs).vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct((n_row_blocks + 1, TR, H),
+                                         jnp.float32)
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(rowb, colb, tiles, x_slabs)
+
+
+def dense_apply_pallas(spec, tiles, rowb, colb, perm_src, perm_out, h,
+                       interpret: bool = False):
+    """Drop-in for ops/block_spmm._dense_apply running the fused kernel.
+
+    Unvisited output row-blocks hold uninitialized memory on hardware; they
+    are zeroed here with a mask derived from rowb (visited row-blocks), which
+    is cheap and fuses into the final permutation gather."""
+    from bnsgcn_tpu.ops.block_spmm import build_x_slabs
+    H = h.shape[1]
+    x_slabs = build_x_slabs(spec, perm_src, h)
+    out = pallas_tile_matmul(tiles, rowb, colb, x_slabs, spec.n_row_blocks,
+                             interpret=interpret)
+    visited = jnp.zeros((spec.n_row_blocks + 1,), bool).at[rowb].set(True)
+    out = jnp.where(visited[:, None, None], out, 0.0)
+    flat = out[:spec.n_row_blocks].reshape(
+        spec.n_row_blocks * spec.row_tile, H).astype(h.dtype)
+    return flat[perm_out]

@@ -83,3 +83,53 @@ def test_one_step_finite(graph, model, use_pp, norm, spmm, dtype, remat,
 
 def out_dtype_default(blk):
     return blk["feat"].dtype
+
+
+HALO_CASES = [
+    # (model, spmm, halo_exchange, halo_wire, dtype)
+    ("graphsage", "hybrid", "padded", "native", "float32"),
+    ("gcn",       "hybrid", "shift",  "fp8",    "bfloat16"),
+    ("graphsage", "ell",    "shift",  "bf16",   "float32"),
+    ("gat",       "ell",    "shift",  "fp8",    "float32"),
+    ("graphsage", "hybrid", "shift",  "fp8",    "bfloat16"),
+]
+
+
+@pytest.mark.parametrize("model,spmm,halo_exchange,halo_wire,dtype", HALO_CASES)
+def test_one_step_finite_halo_variants(graph, model, spmm, halo_exchange,
+                                       halo_wire, dtype):
+    """New round-2 flags: hybrid SpMM x shift exchange x fp8/bf16 wire."""
+    g = graph
+    cfg = Config(model=model, dropout=0.2, use_pp=True, norm="layer",
+                 spmm=spmm, dtype=dtype, halo_exchange=halo_exchange,
+                 halo_wire=halo_wire, n_train=g.n_train, lr=0.01,
+                 sampling_rate=0.5, heads=2)
+    sizes = (6, 8, 8, 3)
+    spec = ModelSpec(model, sizes, norm="layer", dropout=0.2, use_pp=True,
+                     heads=2, train_size=g.n_train)
+    mesh = make_parts_mesh(4)
+    art = build_artifacts(g, partition_graph(g, 4, method="random", seed=7))
+    fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
+    blk_np = build_block_arrays(art, model)
+    blk_np.update(fns.extra_blk)
+    for k in fns.drop_blk_keys:
+        blk_np.pop(k, None)
+    blk = place_blocks(blk_np, mesh)
+    jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    if dtype == "bfloat16":
+        blk["feat"] = blk["feat"].astype(jdtype)
+    tb = place_replicated(tables, mesh)
+    out = fns.precompute(blk, place_replicated(tables_full, mesh)).astype(jdtype)
+    if model == "gat":
+        blk["feat0_ext"] = out
+    else:
+        blk["feat"] = out
+    params, state = init_params(jax.random.key(0), spec, dtype=jdtype)
+    params = place_replicated(params, mesh)
+    state = place_replicated(state, mesh)
+    _, _, opt = init_training(cfg, spec, mesh, dtype=jdtype)
+    for e in range(2):
+        params, state, opt, loss = fns.train_step(
+            params, state, opt, jnp.uint32(e), blk, tb,
+            jax.random.key(0), jax.random.key(1))
+    assert np.isfinite(float(loss)), (model, spmm, halo_exchange, halo_wire)
