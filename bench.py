@@ -10,10 +10,11 @@ scale x 232,965 nodes at the true ~492 mean degree (scale 0.5 = the P=2
 per-rank node share, ~57M local edges).
 
 vs_baseline = 0.3578 / measured_epoch_time (>1 == faster per chip than the
-reference per GPU). Compute dtype defaults to bf16 — the TPU-native choice;
-the gather unit on a single v5e caps sparse aggregation at ~72 GB/s, which
-is the known single-chip bottleneck this framework addresses by scale-out
-(BNS partition parallelism over the 'parts' mesh axis).
+reference per GPU). Compute dtype defaults to bf16 — the TPU-native choice.
+The v5e gather unit moves 512B rows at ~110 GB/s (the pure-ELL bound); the
+hybrid block-dense SpMM routes clustered edge mass through the MXU instead,
+and scale-out (BNS partition parallelism over the 'parts' mesh axis)
+divides the rest. See BENCH_NOTES.md for the candidate/guard scheme.
 
 Usage: python bench.py [--epochs N] [--scale S] [--avg-degree D]
                        [--dtype bf16|f32] [--json-only]

@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bnsgcn_tpu.ops.ell import EllSpec, build_layouts, make_ell_spmm
+from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
 
 TR = 512          # dst rows per dense tile (square: transposes keep shape,
 TC = 512          # and per-edge slab/output overhead beats narrow tiles)

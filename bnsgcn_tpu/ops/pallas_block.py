@@ -21,8 +21,6 @@ Correctness is pinned against the XLA path in tests (interpret mode off-TPU).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
