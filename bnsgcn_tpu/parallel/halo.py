@@ -171,24 +171,19 @@ def make_halo_plan(spec: HaloSpec, tables: dict, bnd: jax.Array,
 # gradient magnitudes — the standard fp8-comm pitfall).
 # ----------------------------------------------------------------------------
 
-_F8 = jnp.float8_e4m3fn
-_F8_MAX = 448.0
-
-
 def _quant(x: jax.Array, wire: str):
     """x [..., S, d] -> (payload, scales or None); scales over the last two axes."""
     if wire == "bf16":
         return x.astype(jnp.bfloat16), None
-    xf = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xf), axis=(-2, -1), keepdims=True)
-    scale = jnp.maximum(amax, 1e-30) / _F8_MAX
-    return (xf / scale).astype(_F8), scale
+    from bnsgcn_tpu.utils.quant import f8_quant
+    return f8_quant(x, axes=(-2, -1))
 
 
 def _dequant(payload: jax.Array, scale, dtype):
     if scale is None:
         return payload.astype(dtype)
-    return (payload.astype(jnp.float32) * scale).astype(dtype)
+    from bnsgcn_tpu.utils.quant import f8_dequant
+    return f8_dequant(payload, scale, dtype)
 
 
 def _a2a_wire_impl(spec: HaloSpec, send: jax.Array) -> jax.Array:

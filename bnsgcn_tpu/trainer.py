@@ -194,7 +194,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             art.src, art.dst, art.pad_inner, art.n_ext,
             np.stack(perms_i), np.stack(perms_e))
         ell_spmm = make_block_spmm(fwd_b, bwd_b, ell_pair,
-                                   use_pallas=cfg.use_pallas)
+                                   use_pallas=cfg.use_pallas,
+                                   gather_dtype=cfg.spmm_gather)
         ell_keys = tuple(ell_arrays.keys())
     elif cfg.spmm in ("ell", "hybrid") and spec.model in ("gcn", "graphsage"):
         from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
@@ -203,7 +204,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             geometry=art.ell_geometry)
         ell_spmm = make_ell_spmm(fwd_spec, bwd_spec,
                                  len(fwd_spec.widths), len(bwd_spec.widths),
-                                 use_pallas=cfg.use_pallas)
+                                 use_pallas=cfg.use_pallas,
+                                 gather_dtype=cfg.spmm_gather)
         ell_keys = tuple(ell_arrays.keys())
 
     # dense per-row GAT attention over an (uncapped) ELL layout; geometry
@@ -218,6 +220,11 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                 geometry_bwd=(art.ell_geometry or {}).get("bwd"))
             ell_arrays.update(gat_arrays)
             gat_keys = tuple(gat_arrays.keys())
+
+    if cfg.spmm_gather == "fp8" and ell_spmm is None:
+        print(f"spmm_gather=fp8 has no effect for spmm={cfg.spmm!r} / "
+              f"model={spec.model!r} (only the ell/hybrid GCN/GraphSAGE "
+              f"aggregation paths quantize gathers)")
 
     def _aggregate_for(blk):
         if ell_spmm is None:

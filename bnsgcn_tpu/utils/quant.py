@@ -1,0 +1,31 @@
+"""Shared e4m3 quantization codec.
+
+One implementation of the (amax -> scale -> cast) rule used by both the halo
+wire format (parallel/halo.py, per (sender, peer) block scales) and the fp8
+SpMM gather mode (ops/ell.py, one scale per call). Gradients always get
+their OWN scales at their own call sites — activation scales under/overflow
+gradient magnitudes, the standard fp8 pitfall.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+F8 = jnp.float8_e4m3fn
+F8_MAX = 448.0
+_AMAX_FLOOR = 1e-30
+
+
+def f8_quant(x: jax.Array, axes=None, keepdims: bool = True):
+    """Returns (payload e4m3, scale f32). `axes=None`: one scale for the
+    whole tensor (scalar); otherwise per-slice over the given axes."""
+    xf = x.astype(jnp.float32)
+    amax = (jnp.max(jnp.abs(xf)) if axes is None
+            else jnp.max(jnp.abs(xf), axis=axes, keepdims=keepdims))
+    scale = jnp.maximum(amax, _AMAX_FLOOR) / F8_MAX
+    return (xf / scale).astype(F8), scale
+
+
+def f8_dequant(payload: jax.Array, scale, dtype):
+    return (payload.astype(jnp.float32) * scale).astype(dtype)
