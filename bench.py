@@ -91,7 +91,12 @@ def main():
     ap.add_argument("--spmm", choices=["hybrid", "ell"], default="hybrid")
     ap.add_argument("--cache-dir", type=str, default="./bench_cache")
     ap.add_argument("--json-only", action="store_true")
+    ap.add_argument("--budget-s", type=float, default=1500.0,
+                    help="soft wall-clock budget: skip remaining SpMM "
+                         "candidates once exceeded (the JSON line always "
+                         "reports the best measured so far)")
     args = ap.parse_args()
+    t_start = time.time()
 
     import jax
     import jax.numpy as jnp
@@ -193,31 +198,43 @@ def main():
     # ever winning the headline; step-0 comparison keeps legitimately-lossy
     # variants like fp8 gathers from accumulating drift over --epochs)
     if args.spmm == "hybrid":
-        candidates = [("ell", False, "native"), ("ell", False, "fp8"),
-                      ("hybrid", False, "native")]
+        # main contenders first so a tight budget still measures them
+        candidates = [("ell", False, "native"), ("hybrid", False, "native"),
+                      ("ell", False, "fp8")]
         if jax.default_backend() == "tpu":   # pallas kernel is TPU-only
             candidates.append(("hybrid", True, "native"))
     else:
         candidates = [(args.spmm, False, "native")]
-    best, ref_loss = None, None
+    best, ref_loss, ref_final = None, None, None
     for variant in candidates:
         name = (variant[0] + ("+pallas" if variant[1] else "")
                 + ("+f8g" if variant[2] == "fp8" else ""))
+        if best is not None and time.time() - t_start > args.budget_s:
+            log(f"  budget {args.budget_s:.0f}s exceeded; skipping {name}")
+            continue
         try:
             built = setup_and_compile(variant)
+            l0 = float(built[6])      # first-step (forward-dominated) loss
+            if ref_loss is not None and                     not (abs(l0 - ref_loss) <= 0.02 * abs(ref_loss) + 1e-3):
+                log(f"  spmm={name} step-0 loss {l0:.4f} != reference "
+                    f"{ref_loss:.4f}; DISCARDED")
+                continue
+            et, mt, loss = measure(built)
         except Exception as ex:       # pragma: no cover - fallback path
             log(f"  spmm={name} failed ({type(ex).__name__}: {ex}); "
                 f"falling back")
             continue
-        l0 = float(built[6])          # first-step loss from setup
+        lf = float(loss)
+        # end-of-run gate exercises the BACKWARD too (a miscompiled gradient
+        # diverges the trajectory); fp8 variants get drift headroom
+        tol = 0.10 if variant[2] == "fp8" else 0.02
         if ref_loss is None:
-            ref_loss = l0
-        elif not (abs(l0 - ref_loss) <= 0.02 * abs(ref_loss) + 1e-3):
-            log(f"  spmm={name} step-0 loss {l0:.4f} != reference "
-                f"{ref_loss:.4f}; DISCARDED")
+            ref_loss, ref_final = l0, lf
+        elif not (abs(lf - ref_final) <= tol * abs(ref_final) + 1e-3):
+            log(f"  spmm={name} final loss {lf:.4f} != reference "
+                f"{ref_final:.4f} (tol {tol:.0%}); DISCARDED")
             continue
-        et, mt, loss = measure(built)
-        log(f"  spmm={name}: {et:.4f}s/epoch loss={float(loss):.4f}")
+        log(f"  spmm={name}: {et:.4f}s/epoch loss={lf:.4f}")
         if best is None or et < best[0]:
             best = (et, mt, loss, name, built[-1])
         del built

@@ -221,10 +221,11 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             ell_arrays.update(gat_arrays)
             gat_keys = tuple(gat_arrays.keys())
 
-    if cfg.spmm_gather == "fp8" and ell_spmm is None:
+    if cfg.spmm_gather == "fp8" and ell_spmm is None and jax.process_index() == 0:
+        import sys
         print(f"spmm_gather=fp8 has no effect for spmm={cfg.spmm!r} / "
               f"model={spec.model!r} (only the ell/hybrid GCN/GraphSAGE "
-              f"aggregation paths quantize gathers)")
+              f"aggregation paths quantize gathers)", file=sys.stderr)
 
     def _aggregate_for(blk):
         if ell_spmm is None:
