@@ -210,61 +210,6 @@ _PER_PART = ["feat", "label", "train_mask", "val_mask", "test_mask",
 # ----------------------------------------------------------------------------
 
 
-def _pow2_bucket(deg: np.ndarray) -> np.ndarray:
-    """Ladder bucket index of each positive degree for widths (4, 8, 16, ...):
-    deg in (0,4] -> 0, (4,8] -> 1, (2^j, 2^(j+1)] -> j-1 (matches
-    ops/ell._bucketize against ops/ell._choose_widths ladders exactly)."""
-    d = np.maximum(deg, 1)
-    return np.maximum(np.ceil(np.log2(d)).astype(np.int64), 2) - 2
-
-
-class _GeoAccum:
-    """Accumulates per-part degree statistics into the compute_geometry dict
-    without holding any stacked arrays: per-part pow2-bucket counts (below the
-    cap), split-row counts and chunk sums (above it), and the global max."""
-
-    def __init__(self, cap):
-        self.cap = cap
-        self.rows_max = np.zeros(64, dtype=np.int64)
-        self.split_max = 0
-        self.chunk_max = 0
-        self.max_deg = 0
-
-    def add_part(self, deg: np.ndarray):
-        deg = deg[deg > 0]
-        if deg.size == 0:
-            return
-        self.max_deg = max(self.max_deg, int(deg.max()))
-        if self.cap:
-            over = deg > self.cap
-            n_split = int(over.sum())
-            if n_split:
-                self.split_max = max(self.split_max, n_split)
-                self.chunk_max = max(self.chunk_max, int(
-                    np.ceil(deg[over] / self.cap).sum()))
-                deg = deg[~over]
-        if deg.size:
-            b = np.bincount(_pow2_bucket(deg), minlength=64)
-            self.rows_max = np.maximum(self.rows_max, b)
-
-    def finish(self) -> dict:
-        from bnsgcn_tpu.ops.ell import _choose_widths
-        if self.max_deg == 0:
-            return {"widths": [4], "rows": [0], "split": 0, "chunks": 0,
-                    "cap": None}
-        fake = np.asarray([self.max_deg])
-        widths = _choose_widths(fake, cap=self.cap)
-        eff_cap = self.cap if (self.cap and self.max_deg > self.cap) else None
-        rows = [int(r) for r in self.rows_max[:len(widths)]]
-        pad8 = lambda r: ((r + 7) // 8) * 8 if r else 0
-        split = chunks = 0
-        if eff_cap:
-            split, chunks = pad8(self.split_max), pad8(self.chunk_max)
-            rows[-1] += self.chunk_max
-        return {"widths": [int(w) for w in widths], "rows": [pad8(r) for r in rows],
-                "split": split, "chunks": chunks, "cap": eff_cap}
-
-
 def build_artifacts_streaming(g: Graph, part_id: np.ndarray, path: str,
                               feat_dtype: str = "float32",
                               with_gat: bool = True,
@@ -284,7 +229,7 @@ def build_artifacts_streaming(g: Graph, part_id: np.ndarray, path: str,
       * uncompressed .npz by default (np.savez_compressed costs minutes at
         tens of GB; pass compress=True for the small-graph behavior).
     """
-    from bnsgcn_tpu.ops.ell import ELL_SPLIT_CAP
+    from bnsgcn_tpu.ops.ell import ELL_SPLIT_CAP, GeoAccum
     import ml_dtypes
 
     log = log or (lambda *a: None)
@@ -343,9 +288,9 @@ def build_artifacts_streaming(g: Graph, part_id: np.ndarray, path: str,
     eoff = np.concatenate([[0], np.cumsum(e_counts)])
     pad_edges = _pad_to(int(e_counts.max()), edge_mult)
 
-    geo_fwd = _GeoAccum(ELL_SPLIT_CAP)
-    geo_bwd = _GeoAccum(ELL_SPLIT_CAP)
-    geo_gat = _GeoAccum(None) if with_gat else None
+    geo_fwd = GeoAccum(ELL_SPLIT_CAP)
+    geo_bwd = GeoAccum(ELL_SPLIT_CAP)
+    geo_gat = GeoAccum(None) if with_gat else None
 
     os.makedirs(path, exist_ok=True)
     save = np.savez_compressed if compress else np.savez

@@ -40,7 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
+from bnsgcn_tpu.ops.ell import (ELL_SPLIT_CAP, GeoAccum, build_layouts,
+                                make_ell_spmm)
 
 TR = 512          # dst rows per dense tile (square: transposes keep shape,
 TC = 512          # and per-edge slab/output overhead beats narrow tiles)
@@ -130,10 +131,15 @@ def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
 
 def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
                         perm_ext, occupancy_min=512,
-                        tile_budget_bytes=2 << 30):
+                        tile_budget_bytes=2 << 30, agree=None):
     """Hybrid layout for all local parts. perm_inner [P, n_dst] /
     perm_ext [P, n_src_ext]: cluster position per original row (the inner
     prefix of perm_ext must equal perm_inner).
+
+    `agree`: optional callable (dict of int arrays) -> elementwise-maxed
+    dict, used on multi-host runs so every process builds identically-shaped
+    tile stacks and residual ELL tables from its LOCAL parts alone (the
+    trainer wires jax process_allgather through it).
 
     Returns (fwd BlockSpec, bwd BlockSpec, ell pair (spec, spec, buckets),
     arrays dict stacked on parts)."""
@@ -154,6 +160,18 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
         res_dst.append(np.concatenate([d[resid], orig_inner[xr]]))
 
     B = max(max(e[0].shape[0] for e in per_part), 1)
+    # residual geometry stats (mergeable across hosts)
+    acc_f, acc_b = GeoAccum(ELL_SPLIT_CAP), GeoAccum(ELL_SPLIT_CAP)
+    for p in range(P):
+        acc_f.add_part(np.bincount(res_dst[p], minlength=n_dst))
+        acc_b.add_part(np.bincount(res_src[p], minlength=n_src_ext))
+    if agree is not None:
+        merged = agree({"B": np.asarray([B], np.int64),
+                        "geo_f": acc_f.state(), "geo_b": acc_b.state()})
+        B = int(merged["B"][0])
+        acc_f.merge_state(merged["geo_f"])
+        acc_b.merge_state(merged["geo_b"])
+    res_geometry = {"fwd": acc_f.finish(), "bwd": acc_b.finish()}
     n_rb_f = (n_dst + TR - 1) // TR
     n_rb_b = (n_src_ext + TC - 1) // TC
     tiles_f = np.zeros((P, B, TR, TC), dtype=np.int8)
@@ -193,7 +211,8 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
         r_src[p, :k] = res_src[p]
         r_dst[p, :k] = res_dst[p]
     ell_fwd, ell_bwd, ell_arrays = build_layouts(r_src, r_dst, n_dst,
-                                                 n_src_ext)
+                                                 n_src_ext,
+                                                 geometry=res_geometry)
     for k, v in ell_arrays.items():
         arrays[f"res_{k}"] = v
 

@@ -410,3 +410,71 @@ def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
 
     spmm.defvjp(fwd, bwd)
     return spmm
+
+
+def _pow2_bucket(deg: np.ndarray) -> np.ndarray:
+    """Ladder bucket index of each positive degree for widths (4, 8, 16, ...):
+    deg in (0,4] -> 0, (4,8] -> 1, (2^j, 2^(j+1)] -> j-1 (matches
+    ops/ell._bucketize against ops/ell._choose_widths ladders exactly)."""
+    d = np.maximum(deg, 1)
+    return np.maximum(np.ceil(np.log2(d)).astype(np.int64), 2) - 2
+
+
+class GeoAccum:
+    """Accumulates per-part degree statistics into the compute_geometry dict
+    without holding any stacked arrays: per-part pow2-bucket counts (below the
+    cap), split-row counts and chunk sums (above it), and the global max."""
+
+    def __init__(self, cap):
+        self.cap = cap
+        self.rows_max = np.zeros(64, dtype=np.int64)
+        self.split_max = 0
+        self.chunk_max = 0
+        self.max_deg = 0
+
+    def add_part(self, deg: np.ndarray):
+        deg = deg[deg > 0]
+        if deg.size == 0:
+            return
+        self.max_deg = max(self.max_deg, int(deg.max()))
+        if self.cap:
+            over = deg > self.cap
+            n_split = int(over.sum())
+            if n_split:
+                self.split_max = max(self.split_max, n_split)
+                self.chunk_max = max(self.chunk_max, int(
+                    np.ceil(deg[over] / self.cap).sum()))
+                deg = deg[~over]
+        if deg.size:
+            b = np.bincount(_pow2_bucket(deg), minlength=64)
+            self.rows_max = np.maximum(self.rows_max, b)
+
+    def state(self) -> "np.ndarray":
+        """Fixed-size mergeable stats vector (for cross-host agreement):
+        [rows_max[64], split_max, chunk_max, max_deg]."""
+        return np.concatenate([self.rows_max,
+                               [self.split_max, self.chunk_max, self.max_deg]]
+                              ).astype(np.int64)
+
+    def merge_state(self, state: "np.ndarray"):
+        """Elementwise-max another accumulator's state() into this one."""
+        self.rows_max = np.maximum(self.rows_max, state[:64])
+        self.split_max = max(self.split_max, int(state[64]))
+        self.chunk_max = max(self.chunk_max, int(state[65]))
+        self.max_deg = max(self.max_deg, int(state[66]))
+
+    def finish(self) -> dict:
+        if self.max_deg == 0:
+            return {"widths": [4], "rows": [0], "split": 0, "chunks": 0,
+                    "cap": None}
+        fake = np.asarray([self.max_deg])
+        widths = _choose_widths(fake, cap=self.cap)
+        eff_cap = self.cap if (self.cap and self.max_deg > self.cap) else None
+        rows = [int(r) for r in self.rows_max[:len(widths)]]
+        pad8 = lambda r: ((r + 7) // 8) * 8 if r else 0
+        split = chunks = 0
+        if eff_cap:
+            split, chunks = pad8(self.split_max), pad8(self.chunk_max)
+            rows[-1] += self.chunk_max
+        return {"widths": [int(w) for w in widths], "rows": [pad8(r) for r in rows],
+                "split": split, "chunks": chunks, "cap": eff_cap}

@@ -176,28 +176,39 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     # scatter-free SpMM layouts (GCN/SAGE aggregation path): 'ell' (bucketed
     # gathers) or 'hybrid' (dense int8 adjacency tiles on the MXU + ELL
-    # residual — ops/block_spmm.py; needs all parts local for the tiling, so
-    # multi-host partial loads fall back to 'ell')
+    # residual — ops/block_spmm.py). Multi-host partial loads agree on the
+    # tile-stack and residual-table shapes via a host-side allgather so every
+    # process compiles the identical program from its local parts.
     ell_spmm, ell_keys, ell_arrays = None, (), {}
-    want_hybrid = (cfg.spmm == "hybrid" and spec.model in ("gcn", "graphsage")
-                   and art.feat.shape[0] == art.n_parts)
+    want_hybrid = (cfg.spmm == "hybrid"
+                   and spec.model in ("gcn", "graphsage"))
     if want_hybrid:
         from bnsgcn_tpu.ops.block_spmm import (build_block_layouts,
                                                cluster_order, make_block_spmm)
+        agree = None
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            def agree(stats):
+                return {k: np.asarray(
+                    multihost_utils.process_allgather(np.asarray(v))
+                ).max(axis=0) for k, v in stats.items()}
+
+        n_local = art.feat.shape[0]
         perms_i, perms_e = [], []
-        for p in range(art.n_parts):
+        for p in range(n_local):
             pi, pe = cluster_order(art.src[p], art.dst[p], art.pad_inner,
                                    art.n_ext)
             perms_i.append(pi)
             perms_e.append(pe)
         fwd_b, bwd_b, ell_pair, ell_arrays = build_block_layouts(
             art.src, art.dst, art.pad_inner, art.n_ext,
-            np.stack(perms_i), np.stack(perms_e))
+            np.stack(perms_i), np.stack(perms_e), agree=agree)
         ell_spmm = make_block_spmm(fwd_b, bwd_b, ell_pair,
                                    use_pallas=cfg.use_pallas,
                                    gather_dtype=cfg.spmm_gather)
         ell_keys = tuple(ell_arrays.keys())
-    elif cfg.spmm in ("ell", "hybrid") and spec.model in ("gcn", "graphsage"):
+    elif cfg.spmm == "ell" and spec.model in ("gcn", "graphsage"):
         from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
         fwd_spec, bwd_spec, ell_arrays = build_layouts(
             art.src, art.dst, art.pad_inner, art.n_ext,

@@ -22,7 +22,7 @@ def _free_port():
 
 
 def _launch(rank, port, tmp, epochs, resume=False, mesh_eval=False,
-            inductive=False, model="graphsage"):
+            inductive=False, model="graphsage", spmm=None):
     env = os.environ.copy()
     env.update({
         "PALLAS_AXON_POOL_IPS": "",
@@ -38,6 +38,8 @@ def _launch(rank, port, tmp, epochs, resume=False, mesh_eval=False,
            "--n-nodes", "2", "--node-rank", str(rank), "--port", str(port),
            "--part-path", f"{tmp}/parts", "--ckpt-path", f"{tmp}/ckpt",
            "--results-path", f"{tmp}/res"]
+    if spmm:
+        cmd += ["--spmm", spmm]
     cmd.append("--eval-device" if mesh_eval else "--no-eval")
     if mesh_eval:
         cmd.append("mesh")
@@ -114,6 +116,29 @@ def test_two_process_gat_ell_attention(tmp_path):
     last = float(losses[0][-1].split()[-1])
     assert last < first, (first, last)
     assert "falling back" not in outs[0]          # ELL attention ran
+
+
+def test_two_process_hybrid_spmm(tmp_path):
+    """Multi-host --spmm hybrid: each process tiles its LOCAL parts and the
+    stack/residual shapes agree via the host allgather — identical losses,
+    no ell fallback."""
+    tmp = str(tmp_path)
+    env = os.environ.copy()
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                "PYTHONPATH": REPO})
+    subprocess.run([sys.executable, "-m", "bnsgcn_tpu.partition_cli",
+                    "--dataset", "sbm", "--n-partitions", "8", "--fix-seed",
+                    "--part-path", f"{tmp}/parts"],
+                   env=env, check=True, capture_output=True, cwd=REPO)
+    port = _free_port()
+    procs = [_launch(r, port, tmp, epochs=25, spmm="hybrid") for r in (0, 1)]
+    outs = [p.communicate(timeout=280)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    losses = [[ln for ln in o.splitlines() if "Loss" in ln] for o in outs]
+    assert losses[0] and losses[0][-1].split()[-1] == losses[1][-1].split()[-1]
+    assert float(losses[0][-1].split()[-1]) < float(losses[0][0].split()[-1])
+    assert "falling back" not in outs[0]
 
 
 def test_two_process_inductive_mesh_eval(tmp_path):
