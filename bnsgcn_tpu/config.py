@@ -71,6 +71,11 @@ class Config:
     spmm_gather: str = "native"         # 'native' | 'fp8': quantize SpMM gather rows to
                                         # e4m3 (+1 scale per call) — the gather unit is
                                         # row-rate bound, so 256B rows move ~1.5x faster
+    block_occupancy: int = 512          # hybrid SpMM: min edges for a 512x512 tile to
+                                        # densify (byte break-even ~512; MXU-time
+                                        # break-even nearer ~1200 at 31 TFLOP/s)
+    block_tile_budget_mb: int = 2048    # hybrid SpMM: int8 dense-tile HBM budget per
+                                        # direction (8192 tiles at 512x512)
     profile_dir: str = ""               # write a jax.profiler trace of a few epochs here
     remat: bool = False                 # rematerialize each layer in backward (saves HBM,
                                         # recomputes activations incl. the halo exchange)
@@ -167,6 +172,8 @@ def create_parser() -> argparse.ArgumentParser:
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)
     both("spmm-gather", type=str, default="native", choices=["native", "fp8"])
+    both("block-occupancy", type=int, default=512)
+    both("block-tile-budget-mb", type=int, default=2048)
     both("ckpt-path", type=str, default="./checkpoint/")
     both("results-path", type=str, default="./results/")
     p.add_argument("--resume", action="store_true")

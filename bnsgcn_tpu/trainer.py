@@ -190,8 +190,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     if want_hybrid:
         from bnsgcn_tpu.ops.block_spmm import (build_block_layouts,
                                                cluster_order, make_block_spmm)
-        if layout_cache is not None and "hybrid" in layout_cache:
-            fwd_b, bwd_b, ell_pair, ell_arrays = layout_cache["hybrid"]
+        hyb_key = f"hybrid:{cfg.block_occupancy}:{cfg.block_tile_budget_mb}"
+        if layout_cache is not None and hyb_key in layout_cache:
+            fwd_b, bwd_b, ell_pair, ell_arrays = layout_cache[hyb_key]
         else:
             agree = None
             if jax.process_count() > 1:
@@ -211,10 +212,12 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                 perms_e.append(pe)
             fwd_b, bwd_b, ell_pair, ell_arrays = build_block_layouts(
                 art.src, art.dst, art.pad_inner, art.n_ext,
-                np.stack(perms_i), np.stack(perms_e), agree=agree)
+                np.stack(perms_i), np.stack(perms_e), agree=agree,
+                occupancy_min=cfg.block_occupancy,
+                tile_budget_bytes=cfg.block_tile_budget_mb << 20)
             if layout_cache is not None:
-                layout_cache["hybrid"] = (fwd_b, bwd_b, ell_pair,
-                                          dict(ell_arrays))
+                layout_cache[hyb_key] = (fwd_b, bwd_b, ell_pair,
+                                         dict(ell_arrays))
         ell_arrays = dict(ell_arrays)   # never alias the cache (extra_blk is
         ell_spmm = make_block_spmm(fwd_b, bwd_b, ell_pair,  # caller-mutable)
                                    use_pallas=cfg.use_pallas,

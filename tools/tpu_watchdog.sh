@@ -1,0 +1,32 @@
+#!/bin/bash
+# Wait for the axon TPU tunnel to come back, then run the headline bench
+# runs immediately. Pallas is excluded here (--no-pallas): a killed Pallas
+# remote-compile is the prime suspect for wedging the tunnel, so the
+# measurement session probes it separately, LAST. Re-probes liveness
+# between runs because a timed-out run can wedge the tunnel again.
+cd /root/repo
+DEADLINE=$(( $(date +%s) + ${1:-28800} ))   # default: wait up to 8h
+
+alive() {
+  timeout 180 python -c \
+    "import jax; assert jax.devices() and jax.default_backend() == 'tpu'" \
+    >/dev/null 2>&1
+}
+
+wait_alive() {
+  while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if alive; then echo "TPU ALIVE at $(date -u +%H:%M:%S)" >> /tmp/tpu_status; return 0; fi
+    echo "TPU down at $(date -u +%H:%M:%S)" >> /tmp/tpu_status
+    sleep 120
+  done
+  echo "TPU never came back" >> /tmp/tpu_status
+  exit 1
+}
+
+wait_alive
+timeout 3000 python bench.py --epochs 8 --no-pallas > /tmp/bench_hw_dcsbm.log 2>&1
+echo "bench dcsbm rc=$?" >> /tmp/tpu_status
+wait_alive
+timeout 2400 python bench.py --graph uniform --epochs 8 --no-pallas > /tmp/bench_hw_uniform.log 2>&1
+echo "bench uniform rc=$?" >> /tmp/tpu_status
+echo DONE >> /tmp/tpu_status
