@@ -14,13 +14,17 @@ alive() {
 }
 
 wait_alive() {
-  while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  # probe FIRST: the deadline bounds waiting, it must not abort work that
+  # needs no wait (e.g. the second bench right after a long first one)
+  while true; do
     if alive; then echo "TPU ALIVE at $(date -u +%H:%M:%S)" >> /tmp/tpu_status; return 0; fi
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+      echo "TPU never came back" >> /tmp/tpu_status
+      exit 1
+    fi
     echo "TPU down at $(date -u +%H:%M:%S)" >> /tmp/tpu_status
     sleep 120
   done
-  echo "TPU never came back" >> /tmp/tpu_status
-  exit 1
 }
 
 wait_alive
