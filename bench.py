@@ -39,23 +39,32 @@ BASELINE_EPOCH_S = 0.3578   # reference README.md:94 (rank 0, Reddit P=2 rate=0.
 _CACHE_VER = 1              # bump when artifact/layout formats change
 
 
+def _try_load(path: str, log):
+    """Versioned-pickle read; None on missing/stale/corrupt (a bad cache
+    must never kill the bench)."""
+    if not os.path.exists(path):
+        return None
+    t0 = time.time()
+    try:
+        with open(path, "rb") as f:
+            ver, obj = pickle.load(f)
+        if ver != _CACHE_VER:
+            log(f"  stale cache version {ver} at {path}; ignoring")
+            return None
+        log(f"  loaded {os.path.basename(path)} in {time.time() - t0:.1f}s")
+        return obj
+    except Exception as ex:
+        log(f"  cache read failed at {path} ({type(ex).__name__})")
+        return None
+
+
 def _disk_cached(path: str, build, log):
     """Pickle-backed build cache (artifacts + SpMM layouts are minutes of
     numpy at bench scale — pre-buildable on CPU while the TPU idles)."""
-    if os.path.exists(path):
-        t0 = time.time()
-        try:
-            with open(path, "rb") as f:
-                ver, obj = pickle.load(f)
-            if ver == _CACHE_VER:
-                log(f"  loaded {os.path.basename(path)} "
-                    f"in {time.time() - t0:.1f}s")
-                return obj
-            log(f"  stale cache version {ver} at {path}; rebuilding")
-        except Exception as ex:        # corrupt cache never kills the bench
-            log(f"  cache read failed ({type(ex).__name__}); rebuilding")
-    obj = build()
-    _atomic_dump(obj, path)
+    obj = _try_load(path, log)
+    if obj is None:
+        obj = build()
+        _atomic_dump(obj, path)
     return obj
 
 
@@ -64,18 +73,6 @@ def _atomic_dump(obj, path: str):
     with open(tmp, "wb") as f:          # bench may write concurrently
         pickle.dump((_CACHE_VER, obj), f, protocol=4)
     os.replace(tmp, path)
-
-
-def _load_cache_file(path: str, log) -> dict:
-    if not os.path.exists(path):
-        return {}
-    try:
-        with open(path, "rb") as f:
-            ver, obj = pickle.load(f)
-        return obj if ver == _CACHE_VER else {}
-    except Exception as ex:
-        log(f"  cache read failed at {path} ({type(ex).__name__})")
-        return {}
 
 
 def _features(label: np.ndarray, n_feat=602, n_class=41) -> np.ndarray:
@@ -155,7 +152,16 @@ def main():
     args = ap.parse_args()
     t_start = time.time()
 
+    if args.prep_only:
+        # prep is pure host numpy — never touch the TPU for it. (If the
+        # axon tunnel is WEDGED, the sitecustomize hangs at interpreter
+        # start, before this line: launch with PALLAS_AXON_POOL_IPS= then.)
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+
+    if args.prep_only:
+        from bnsgcn_tpu.utils.platform import honor_platform_request
+        honor_platform_request(strict=True)
     import jax.numpy as jnp
 
     from bnsgcn_tpu.config import Config
@@ -190,11 +196,12 @@ def main():
     skey, dkey = jax.random.key(0), jax.random.key(1)
 
     def make_cfg(variant):
-        spmm, use_pallas, gather = variant
+        spmm, use_pallas, gather, dense = variant
         return Config(model="graphsage", n_layers=args.layers,
                       n_hidden=args.hidden, use_pp=True, dropout=0.5,
                       lr=0.01, sampling_rate=0.1, spmm=spmm,
                       use_pallas=use_pallas, spmm_gather=gather,
+                      spmm_dense=dense,
                       block_occupancy=args.occupancy,
                       block_tile_budget_mb=args.tile_budget_mb,
                       n_feat=art.n_feat, n_class=art.n_class,
@@ -204,7 +211,7 @@ def main():
         """Layouts + device data + the first (compiling) train step — any
         failure here on real hardware triggers the ELL fallback."""
         t0 = time.time()
-        spmm, use_pallas, gather = variant
+        spmm = variant[0]
         cfg = make_cfg(variant)
         fns, hspec, tables, tables_full = build_step_fns(
             cfg, spec, art, mesh, layout_cache=layout_cache)
@@ -265,12 +272,15 @@ def main():
     # variants like fp8 gathers from accumulating drift over --epochs)
     if args.spmm == "hybrid":
         # main contenders first so a tight budget still measures them
-        candidates = [("ell", False, "native"), ("hybrid", False, "native"),
-                      ("hybrid", False, "fp8"), ("ell", False, "fp8")]
+        candidates = [("ell", False, "native", "native"),
+                      ("hybrid", False, "native", "native"),
+                      ("hybrid", False, "fp8", "int8"),
+                      ("hybrid", False, "fp8", "native"),
+                      ("ell", False, "fp8", "native")]
         if jax.default_backend() == "tpu" and not args.no_pallas:
-            candidates.append(("hybrid", True, "native"))   # pallas: TPU-only
+            candidates.append(("hybrid", True, "native", "native"))
     else:
-        candidates = [(args.spmm, False, "native")]
+        candidates = [(args.spmm, False, "native", "native")]
     best, ref_loss, ref_final = None, None, None
     # share built layouts across candidates AND across runs (disk): key set
     # must match trainer.build_step_fns ('ell', f'hybrid:{occ}:{budget}').
@@ -280,8 +290,8 @@ def main():
     hyb_path = os.path.join(
         args.cache_dir,
         f"layouts_hyb_{tag}_{args.occupancy}_{args.tile_budget_mb}.pkl")
-    layout_cache = _load_cache_file(ell_path, log)
-    layout_cache.update(_load_cache_file(hyb_path, log))
+    layout_cache = _try_load(ell_path, log) or {}
+    layout_cache.update(_try_load(hyb_path, log) or {})
     if layout_cache:
         log(f"  layout cache: {sorted(layout_cache)}")
     lc_keys0 = set(layout_cache)
@@ -312,7 +322,8 @@ def main():
 
     for variant in candidates:
         name = (variant[0] + ("+pallas" if variant[1] else "")
-                + ("+f8g" if variant[2] == "fp8" else ""))
+                + ("+f8g" if variant[2] == "fp8" else "")
+                + ("+i8d" if variant[3] == "int8" else ""))
         if best is not None and time.time() - t_start > args.budget_s:
             log(f"  budget {args.budget_s:.0f}s exceeded; skipping {name}")
             continue
@@ -333,8 +344,9 @@ def main():
             continue
         lf = float(loss)
         # end-of-run gate exercises the BACKWARD too (a miscompiled gradient
-        # diverges the trajectory); fp8 variants get drift headroom
-        tol = 0.10 if variant[2] == "fp8" else 0.02
+        # diverges the trajectory); quantized variants get drift headroom
+        tol = 0.10 if (variant[2] == "fp8"
+                       or variant[3] == "int8") else 0.02
         if ref_loss is None:
             ref_loss, ref_final = l0, lf
         elif not (abs(lf - ref_final) <= tol * abs(ref_final) + 1e-3):

@@ -71,6 +71,9 @@ class Config:
     spmm_gather: str = "native"         # 'native' | 'fp8': quantize SpMM gather rows to
                                         # e4m3 (+1 scale per call) — the gather unit is
                                         # row-rate bound, so 256B rows move ~1.5x faster
+    spmm_dense: str = "native"          # hybrid SpMM dense-tile matmul dtype: 'native'
+                                        # (compute dtype) | 'int8' (quantized slabs,
+                                        # int8x int8 MXU at ~2x bf16 rate)
     block_occupancy: int = 512          # hybrid SpMM: min edges for a 512x512 tile to
                                         # densify (byte break-even ~512; MXU-time
                                         # break-even nearer ~1200 at 31 TFLOP/s)
@@ -172,6 +175,7 @@ def create_parser() -> argparse.ArgumentParser:
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)
     both("spmm-gather", type=str, default="native", choices=["native", "fp8"])
+    both("spmm-dense", type=str, default="native", choices=["native", "int8"])
     both("block-occupancy", type=int, default=512)
     both("block-tile-budget-mb", type=int, default=2048)
     both("ckpt-path", type=str, default="./checkpoint/")

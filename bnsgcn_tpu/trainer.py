@@ -221,7 +221,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         ell_arrays = dict(ell_arrays)   # never alias the cache (extra_blk is
         ell_spmm = make_block_spmm(fwd_b, bwd_b, ell_pair,  # caller-mutable)
                                    use_pallas=cfg.use_pallas,
-                                   gather_dtype=cfg.spmm_gather)
+                                   gather_dtype=cfg.spmm_gather,
+                                   dense_dtype=cfg.spmm_dense)
         ell_keys = tuple(ell_arrays.keys())
     elif cfg.spmm == "ell" and spec.model in ("gcn", "graphsage"):
         from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm

@@ -186,3 +186,29 @@ def test_cluster_order_is_permutation():
     assert sorted(pi.tolist()) == list(range(art.pad_inner))
     assert sorted(pe.tolist()) == list(range(art.n_ext))
     np.testing.assert_array_equal(pe[:art.pad_inner], pi)
+
+
+def test_int8_dense_path_close_to_native():
+    """dense_dtype='int8' (quantized slabs, int8 x int8 MXU tiles) tracks
+    the exact path within quantization tolerance, forward and gradient."""
+    g = sbm_graph(n_nodes=300, n_class=5, n_feat=6, p_in=0.15, p_out=0.003,
+                  seed=68)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=3))
+    fwd, bwd, ell_pair, arrays = _hybrid_for(art, 4)
+    assert dense_edge_count(arrays, 0) > 0
+    exact = make_block_spmm(fwd, bwd, ell_pair)
+    quant = make_block_spmm(fwd, bwd, ell_pair, dense_dtype="int8")
+    rng = np.random.default_rng(5)
+    h = jnp.asarray(rng.normal(size=(art.n_ext, 7)), jnp.float32)
+    a = {k: jnp.asarray(v[0]) for k, v in arrays.items()}
+    ref = np.asarray(exact(a, h))
+    got = np.asarray(quant(a, h))
+    scale = np.abs(ref).max()
+    np.testing.assert_allclose(got, ref, atol=0.05 * scale)
+    cot = rng.normal(size=ref.shape).astype(np.float32)
+    d_ref = np.asarray(jax.grad(
+        lambda hh: jnp.sum(exact(a, hh) * cot))(h))
+    d_got = np.asarray(jax.grad(
+        lambda hh: jnp.sum(quant(a, hh) * cot))(h))
+    np.testing.assert_allclose(d_got, d_ref,
+                               atol=0.05 * np.abs(d_ref).max())

@@ -1,16 +1,24 @@
 """One-shot hardware measurement session — run when the axon TPU tunnel is up.
 
-Covers every TPU-dependent item queued this round, in dependency order, with
-per-stage timeouts so one hung stage doesn't eat the session:
+Covers every TPU-dependent item queued this round, with per-stage timeouts
+so one hung stage doesn't eat the session. Stage ORDER is risk-ordered, not
+dependency-ordered: the headline benches run FIRST (they only need XLA and
+their layouts are disk-cached by `bench.py --prep-only`), and the Pallas
+probes run LAST — a Pallas remote-compile killed mid-flight wedged the
+tunnel for hours on 2026-07-29, so nothing may depend on surviving it:
 
-  1. liveness + microbench (gather/matmul/stream, slope method);
-  2. Pallas manual-DMA retest (round-1: remote compiler HTTP 500) and the
-     standard-pipeline grouped-matmul kernel compile;
-  3. fp8/shift halo exchange microbench (the VERDICT 'comm bytes' evidence)
-     on a synthetic multi-part layout via the exchange_only program;
-  4. bench.py on the clustered graph (3 SpMM candidates) and on the uniform
-     graph — the headline numbers;
-  5. a short profiler trace for the Comm(s)-vs-trace cross-check.
+  1. liveness;
+  2. bench.py --no-pallas on the clustered graph (headline) and on the
+     uniform graph (worst case), layouts from the disk cache;
+  3. occupancy/budget tuning probes (hybrid knobs, cached where pre-built);
+  4. a short profiler trace for the Comm(s)-vs-trace cross-check;
+  5. fp8/shift halo exchange byte accounting;
+  6. microbench (gather/matmul/stream — already measured 2026-07-29 AM,
+     rerun only to re-confirm: ~267M 512B-rows/s gather, 31-45 TFLOP/s
+     narrow-N bf16 matmul);
+  7. Pallas probes: standard-pipeline grouped matmul, then manual DMA, then
+     (manually, if both compile) `bench.py --spmm hybrid` WITHOUT
+     --no-pallas to measure the fused dense path.
 
 Usage: python tools/hw_session.py [--skip microbench,...] 2>&1 | tee hw_session.log
 """
@@ -114,6 +122,8 @@ print("COMM PROBE OK", flush=True)
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip", type=str, default="")
+    ap.add_argument("--include", type=str, default="",
+                    help="opt-in stages: 'pallas' (tunnel-wedging risk)")
     ap.add_argument("--epochs", type=int, default=8)
     args = ap.parse_args()
     skip = set(args.skip.split(",")) if args.skip else set()
@@ -127,21 +137,20 @@ def main():
         if not ok:
             print("TPU not reachable — aborting hw session")
             return 1
-    if "microbench" not in skip:
-        results["microbench"] = run("microbench",
-                                    [py, "tools/microbench.py"], 1200)
-    if "pallas" not in skip:
-        results["pallas"] = run("pallas probes", [py, "-c", PALLAS_PROBE], 900)
-    if "comm" not in skip:
-        results["comm"] = run("comm probe", [py, "-c", COMM_PROBE], 300)
     if "bench" not in skip:
         results["bench_dcsbm"] = run(
             "bench dcsbm (headline)",
-            [py, "bench.py", "--epochs", str(args.epochs)], 3600)
+            [py, "bench.py", "--no-pallas", "--epochs", str(args.epochs)],
+            2400)
         results["bench_uniform"] = run(
             "bench uniform (worst case)",
-            [py, "bench.py", "--graph", "uniform", "--epochs",
-             str(args.epochs)], 3600)
+            [py, "bench.py", "--no-pallas", "--graph", "uniform",
+             "--epochs", str(args.epochs)], 2400)
+    if "tune" not in skip:
+        results["tune_occ1024"] = run(
+            "hybrid occupancy 1024",
+            [py, "bench.py", "--no-pallas", "--occupancy", "1024",
+             "--epochs", str(args.epochs)], 2400)
     if "trace" not in skip:
         results["trace"] = run(
             "profiler trace (Comm cross-check)",
@@ -152,6 +161,15 @@ def main():
              "--profile-dir", "/tmp/hw_trace",
              "--part-path", "/tmp/hw_parts", "--ckpt-path", "/tmp/hw_ck",
              "--results-path", "/tmp/hw_res"], 1800)
+    if "comm" not in skip:
+        results["comm"] = run("comm probe", [py, "-c", COMM_PROBE], 300)
+    if "microbench" not in skip:
+        results["microbench"] = run("microbench",
+                                    [py, "tools/microbench.py"], 1200)
+    # LAST, and only on explicit opt-in: a killed Pallas remote-compile has
+    # wedged the tunnel for hours; never let it precede the benches.
+    if "pallas" in (args.include or ""):
+        results["pallas"] = run("pallas probes", [py, "-c", PALLAS_PROBE], 900)
     print("\n=== SUMMARY ===")
     for k, (ok, _) in results.items():
         print(f"{k}: {'OK' if ok else 'FAILED'}")
