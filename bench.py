@@ -333,9 +333,14 @@ def main():
             finally:
                 persist_layouts()     # keep layouts even if compile failed
             l0 = float(built[6])      # first-step (forward-dominated) loss
-            if ref_loss is not None and                     not (abs(l0 - ref_loss) <= 0.02 * abs(ref_loss) + 1e-3):
+            # quantized variants get the same widened tolerance as the
+            # end-of-run gate: fp8 gathers + int8 tiles stack two quantizers
+            # and a legitimately-lossy forward must not read as miscompiled
+            tol0 = 0.10 if (variant[2] == "fp8"
+                            or variant[3] == "int8") else 0.02
+            if ref_loss is not None and                     not (abs(l0 - ref_loss) <= tol0 * abs(ref_loss) + 1e-3):
                 log(f"  spmm={name} step-0 loss {l0:.4f} != reference "
-                    f"{ref_loss:.4f}; DISCARDED")
+                    f"{ref_loss:.4f} (tol {tol0:.0%}); DISCARDED")
                 continue
             et, mt, loss = measure(built)
         except Exception as ex:       # pragma: no cover - fallback path

@@ -241,6 +241,17 @@ def build_x_slabs(spec: BlockSpec, perm_src, h):
     return hp[inv_src].reshape(n_cb, spec.col_tile, H)
 
 
+def _tile_chunk_for(n_blocks: int, row_tile: int, width: int,
+                    budget_bytes: int = 256 << 20) -> int:
+    """Tiles per scan chunk so the f32 per-tile partial product stays under
+    `budget_bytes`. Without chunking, [B, TR, H] f32 partials at bench scale
+    (B=8192, H=602 in the use_pp precompute) are 9.5 GB of HLO temp — over
+    a v5e's 16 GB HBM (observed OOM at jit(precompute))."""
+    per_tile = row_tile * width * 4
+    c = max(64, budget_bytes // per_tile)
+    return int(min(n_blocks, c))
+
+
 def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h,
                  dense_dtype: str = "native"):
     """Dense-tile aggregation; returns [n_rows, H] in ORIGINAL row order.
@@ -251,8 +262,14 @@ def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h,
     int8 at ~2x the bf16 rate, the bf16 tile conversion disappears, and
     slab HBM traffic halves. The per-slab scale is finer than the fp8
     gather path's per-call scale; sums over ~10^2-edge rows average the
-    rounding error out. Guarded end-to-end by the bench loss gates."""
+    rounding error out. Guarded end-to-end by the bench loss gates.
+
+    The tile stack is processed in `lax.scan` chunks (bounded [C, TR, H]
+    partials + one [n_row_blocks+1, TR, H] accumulator) instead of one
+    [B, TR, H] einsum, keeping HLO temps flat in B; rowb is sorted, so
+    per-chunk segment ids stay sorted."""
     H = h.shape[1]
+    B = tiles.shape[0]
     x_perm = build_x_slabs(spec, perm_src, h)
     if dense_dtype == "int8":
         xf = x_perm.astype(jnp.float32)
@@ -260,16 +277,46 @@ def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h,
                             1e-30)                         # [n_cb]
         q = jnp.clip(jnp.round(xf / scale[:, None, None]),
                      -127, 127).astype(jnp.int8)
-        prod = jnp.einsum("brc,bch->brh", tiles, q[colb],
-                          preferred_element_type=jnp.int32)
-        prod = prod.astype(jnp.float32) * scale[colb][:, None, None]
+
+        def chunk_prod(tiles_c, colb_c):
+            p = jnp.einsum("brc,bch->brh", tiles_c, q[colb_c],
+                           preferred_element_type=jnp.int32)
+            return p.astype(jnp.float32) * scale[colb_c][:, None, None]
     else:
-        slabs = x_perm[colb]                               # [B, TC, H]
-        prod = jnp.einsum("brc,bch->brh", tiles.astype(h.dtype), slabs,
-                          preferred_element_type=jnp.float32)  # [B, TR, H]
-    seg = jax.ops.segment_sum(prod, rowb,
-                              num_segments=spec.n_row_blocks + 1,
-                              indices_are_sorted=True)[:spec.n_row_blocks]
+        def chunk_prod(tiles_c, colb_c):
+            return jnp.einsum("brc,bch->brh", tiles_c.astype(h.dtype),
+                              x_perm[colb_c],
+                              preferred_element_type=jnp.float32)
+
+    n_seg = spec.n_row_blocks + 1
+    C = _tile_chunk_for(B, spec.row_tile, H)
+    pad = (-B) % C
+    if pad:
+        # zero tiles routed to the dump segment keep rowb sorted
+        tiles = jnp.concatenate(
+            [tiles, jnp.zeros((pad,) + tiles.shape[1:], tiles.dtype)], 0)
+        rowb = jnp.concatenate(
+            [rowb, jnp.full((pad,), spec.n_row_blocks, rowb.dtype)], 0)
+        colb = jnp.concatenate([colb, jnp.zeros((pad,), colb.dtype)], 0)
+    n_chunks = (B + pad) // C
+    xs = (tiles.reshape(n_chunks, C, *tiles.shape[1:]),
+          rowb.reshape(n_chunks, C), colb.reshape(n_chunks, C))
+
+    def body(acc, x):
+        tiles_c, rowb_c, colb_c = x
+        s = jax.ops.segment_sum(chunk_prod(tiles_c, colb_c), rowb_c,
+                                num_segments=n_seg,
+                                indices_are_sorted=True)
+        return acc + s, None
+
+    # derive the init carry from the input so it carries the same varying
+    # manual axes as the body output under shard_map (scan rejects an
+    # unvarying zeros init against a parts-varying accumulator); the empty
+    # slice reads no data, so a non-finite activation cannot leak NaN here
+    acc0 = jnp.zeros((n_seg, spec.row_tile, H), jnp.float32) \
+        + jnp.sum(x_perm[:0]).astype(jnp.float32)
+    seg, _ = jax.lax.scan(body, acc0, xs)
+    seg = seg[:spec.n_row_blocks]
     flat = seg.reshape(spec.n_row_blocks * spec.row_tile, H).astype(h.dtype)
     return flat[perm_out]                                  # original row order
 
@@ -280,6 +327,11 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
     """Returns spmm(arrays, h_ext) -> [n_dst, H]: dense tiles on the MXU +
     ELL residual, custom VJP running the transposed tiles.
     dense_dtype='int8': quantized int8 MXU tile path (see _dense_apply)."""
+    if use_pallas and dense_dtype != "native":
+        import sys
+        print(f"block_spmm: use_pallas takes the fused Pallas dense path on "
+              f"TPU, which ignores dense_dtype={dense_dtype!r} (tiles run in "
+              f"the compute dtype there)", file=sys.stderr)
     ell_fwd, ell_bwd = ell_pair
     ell = make_ell_spmm(ell_fwd, ell_bwd, len(ell_fwd.widths),
                         len(ell_bwd.widths), use_pallas=use_pallas,

@@ -212,3 +212,47 @@ def test_int8_dense_path_close_to_native():
         lambda hh: jnp.sum(quant(a, hh) * cot))(h))
     np.testing.assert_allclose(d_got, d_ref,
                                atol=0.05 * np.abs(d_ref).max())
+
+
+@pytest.mark.parametrize("chunked", [True, False])
+@pytest.mark.parametrize("dense_dtype", ["native", "int8"])
+def test_chunked_dense_path_matches_oracle(dense_dtype, chunked, monkeypatch):
+    """The lax.scan tile accumulation (keeps HLO temps flat in B — the
+    jit(precompute) OOM fix) must stay exact, forward and gradient, both
+    multi-chunk (incl. B % C != 0 zero-tile padding) and single-chunk
+    (B <= C), on a multi-block geometry where rowb != colb — a wrong
+    slab-gather index (colb vs rowb) only shows up off the diagonal."""
+    import bnsgcn_tpu.ops.block_spmm as bs
+    if chunked:
+        monkeypatch.setattr(bs, "_tile_chunk_for", lambda *a, **k: 4)
+    monkeypatch.setattr(bs, "TR", 64)
+    monkeypatch.setattr(bs, "TC", 64)
+    g = sbm_graph(n_nodes=300, n_class=5, n_feat=6, p_in=0.15, p_out=0.003,
+                  seed=61)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=3))
+    fwd, bwd, ell_pair, arrays = _hybrid_for(art, 4)
+    assert np.any(arrays["blk_rowb_fwd"][0][:fwd.n_blocks]
+                  != arrays["blk_colb_fwd"][0][:fwd.n_blocks]), \
+        "all tiles on the diagonal — wrong-slab-index bug invisible"
+    if chunked:
+        assert fwd.n_blocks > 4 and fwd.n_blocks % 4 != 0, \
+            "chunking path (incl. padding) not exercised"
+    else:
+        assert fwd.n_blocks <= bs._tile_chunk_for(
+            fwd.n_blocks, fwd.row_tile, 7), "expected single-chunk case"
+    spmm = make_block_spmm(fwd, bwd, ell_pair, dense_dtype=dense_dtype)
+    rng = np.random.default_rng(9)
+    h = jnp.asarray(rng.normal(size=(art.n_ext, 7)), jnp.float32)
+    arr0 = {k: jnp.asarray(v[0]) for k, v in arrays.items()}
+    ref = _dense_oracle(art, 0, h)
+    tol = dict(rtol=1e-4, atol=1e-4) if dense_dtype == "native" else \
+        dict(atol=0.05 * np.abs(ref).max())
+    np.testing.assert_allclose(np.asarray(spmm(arr0, h)), ref, **tol)
+    cot = rng.normal(size=ref.shape).astype(np.float32)
+    d_h = np.asarray(jax.grad(lambda hh: jnp.sum(spmm(arr0, hh) * cot))(h))
+    d_ref = np.zeros((art.n_ext, 7))
+    real = art.dst[0] < art.pad_inner
+    np.add.at(d_ref, art.src[0][real], cot[art.dst[0][real]])
+    d_tol = tol if dense_dtype == "native" else \
+        dict(atol=0.05 * np.abs(d_ref).max())
+    np.testing.assert_allclose(d_h, d_ref, **d_tol)
