@@ -149,6 +149,11 @@ def main():
                     help="soft wall-clock budget: skip remaining SpMM "
                          "candidates once exceeded (the JSON line always "
                          "reports the best measured so far)")
+    ap.add_argument("--candidates", type=str, default="",
+                    help="comma list restricting/ordering the SpMM variants "
+                         "to measure after the ell anchor (names as logged: "
+                         "hybrid, hybrid+f8g+i8d, hybrid+f8g, ell+f8g, "
+                         "hybrid+pallas) — for short TPU-tunnel windows")
     args = ap.parse_args()
     t_start = time.time()
 
@@ -162,6 +167,16 @@ def main():
     if args.prep_only:
         from bnsgcn_tpu.utils.platform import honor_platform_request
         honor_platform_request(strict=True)
+    try:
+        # persistent XLA compilation cache: repeat bench runs (and reruns
+        # after a tunnel drop) skip the 20-40s compiles when the program is
+        # unchanged; harmless no-op where the backend ignores it
+        cc_dir = os.path.join(args.cache_dir, "xla_cache")
+        os.makedirs(cc_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cc_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception as ex:           # pragma: no cover
+        print(f"  compilation cache unavailable: {ex}", file=sys.stderr)
     import jax.numpy as jnp
 
     from bnsgcn_tpu.config import Config
@@ -281,6 +296,24 @@ def main():
             candidates.append(("hybrid", True, "native", "native"))
     else:
         candidates = [(args.spmm, False, "native", "native")]
+    def _vname(v):
+        return (v[0] + ("+pallas" if v[1] else "")
+                + ("+f8g" if v[2] == "fp8" else "")
+                + ("+i8d" if v[3] == "int8" else ""))
+
+    if args.candidates:
+        by_name = {_vname(v): v for v in candidates[1:]}
+        picked = []
+        for nm in args.candidates.split(","):
+            nm = nm.strip()
+            if nm and nm in by_name:
+                picked.append(by_name[nm])
+            elif nm:
+                # unconditional stderr: under --json-only `log` is a no-op
+                # and a silently-ignored selection would be invisible
+                print(f"  unknown candidate {nm!r} (known: "
+                      f"{sorted(by_name)}); ignoring", file=sys.stderr)
+        candidates = candidates[:1] + picked
     best, ref_loss, ref_final = None, None, None
     # share built layouts across candidates AND across runs (disk): key set
     # must match trainer.build_step_fns ('ell', f'hybrid:{occ}:{budget}').
@@ -321,9 +354,7 @@ def main():
         return
 
     for variant in candidates:
-        name = (variant[0] + ("+pallas" if variant[1] else "")
-                + ("+f8g" if variant[2] == "fp8" else "")
-                + ("+i8d" if variant[3] == "int8" else ""))
+        name = _vname(variant)
         if best is not None and time.time() - t_start > args.budget_s:
             log(f"  budget {args.budget_s:.0f}s exceeded; skipping {name}")
             continue

@@ -28,9 +28,9 @@ wait_alive() {
 }
 
 wait_alive
-timeout 3000 python bench.py --epochs 8 --no-pallas > /tmp/bench_hw_dcsbm.log 2>&1
+timeout 3600 python bench.py --epochs 8 --no-pallas --budget-s 3000 > /tmp/bench_hw_dcsbm.log 2>&1
 echo "bench dcsbm rc=$?" >> /tmp/tpu_status
 wait_alive
-timeout 2400 python bench.py --graph uniform --epochs 8 --no-pallas > /tmp/bench_hw_uniform.log 2>&1
+timeout 2400 python bench.py --graph uniform --epochs 8 --no-pallas --budget-s 1800 > /tmp/bench_hw_uniform.log 2>&1
 echo "bench uniform rc=$?" >> /tmp/tpu_status
 echo DONE >> /tmp/tpu_status
