@@ -152,8 +152,8 @@ def main():
     ap.add_argument("--candidates", type=str, default="",
                     help="comma list restricting/ordering the SpMM variants "
                          "to measure after the ell anchor (names as logged: "
-                         "hybrid, hybrid+f8g+i8d, hybrid+f8g, ell+f8g, "
-                         "hybrid+pallas) — for short TPU-tunnel windows")
+                         "hybrid, hybrid+i8g+i8d, hybrid+f8g+i8d, hybrid+f8g, "
+                         "ell+i8g, ell+f8g, hybrid+pallas) — for short TPU-tunnel windows")
     args = ap.parse_args()
     t_start = time.time()
 
@@ -285,24 +285,31 @@ def main():
     # with its FIRST-step loss (guards a silently-miscompiling kernel from
     # ever winning the headline; step-0 comparison keeps legitimately-lossy
     # variants like fp8 gathers from accumulating drift over --epochs)
+    # main contenders first so a tight budget still measures them; the
+    # universe is independent of --spmm so --candidates can always select
+    # from the full documented name set
+    universe = [("hybrid", False, "native", "native"),
+                ("hybrid", False, "int8", "int8"),
+                ("hybrid", False, "fp8", "int8"),
+                ("hybrid", False, "fp8", "native"),
+                ("ell", False, "int8", "native"),
+                ("ell", False, "fp8", "native")]
+    if jax.default_backend() == "tpu" and not args.no_pallas:
+        universe.append(("hybrid", True, "native", "native"))
+    anchor = ("ell", False, "native", "native")
     if args.spmm == "hybrid":
-        # main contenders first so a tight budget still measures them
-        candidates = [("ell", False, "native", "native"),
-                      ("hybrid", False, "native", "native"),
-                      ("hybrid", False, "fp8", "int8"),
-                      ("hybrid", False, "fp8", "native"),
-                      ("ell", False, "fp8", "native")]
-        if jax.default_backend() == "tpu" and not args.no_pallas:
-            candidates.append(("hybrid", True, "native", "native"))
+        candidates = [anchor] + universe
     else:
         candidates = [(args.spmm, False, "native", "native")]
+
     def _vname(v):
         return (v[0] + ("+pallas" if v[1] else "")
-                + ("+f8g" if v[2] == "fp8" else "")
+                + ({"fp8": "+f8g", "int8": "+i8g"}.get(v[2], ""))
                 + ("+i8d" if v[3] == "int8" else ""))
 
     if args.candidates:
-        by_name = {_vname(v): v for v in candidates[1:]}
+        by_name = {_vname(v): v for v in universe}
+        candidates = [anchor]
         picked = []
         for nm in args.candidates.split(","):
             nm = nm.strip()
@@ -367,7 +374,7 @@ def main():
             # quantized variants get the same widened tolerance as the
             # end-of-run gate: fp8 gathers + int8 tiles stack two quantizers
             # and a legitimately-lossy forward must not read as miscompiled
-            tol0 = 0.10 if (variant[2] == "fp8"
+            tol0 = 0.10 if (variant[2] != "native"
                             or variant[3] == "int8") else 0.02
             if ref_loss is not None and                     not (abs(l0 - ref_loss) <= tol0 * abs(ref_loss) + 1e-3):
                 log(f"  spmm={name} step-0 loss {l0:.4f} != reference "
@@ -381,7 +388,7 @@ def main():
         lf = float(loss)
         # end-of-run gate exercises the BACKWARD too (a miscompiled gradient
         # diverges the trajectory); quantized variants get drift headroom
-        tol = 0.10 if (variant[2] == "fp8"
+        tol = 0.10 if (variant[2] != "native"
                        or variant[3] == "int8") else 0.02
         if ref_loss is None:
             ref_loss, ref_final = l0, lf

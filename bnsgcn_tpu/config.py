@@ -68,7 +68,7 @@ class Config:
     spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'hybrid'
                                         # (dense int8 MXU tiles + ELL residual) | 'segment'
     use_pallas: bool = False            # use Pallas aggregation kernels where available
-    spmm_gather: str = "native"         # 'native' | 'fp8': quantize SpMM gather rows to
+    spmm_gather: str = "native"         # 'native' | 'fp8' | 'int8': quantize SpMM gather rows to
                                         # e4m3 (+1 scale per call) — the gather unit is
                                         # row-rate bound, so 256B rows move ~1.5x faster
     spmm_dense: str = "native"          # hybrid SpMM dense-tile matmul dtype: 'native'
@@ -174,7 +174,7 @@ def create_parser() -> argparse.ArgumentParser:
          choices=["float32", "bfloat16"])
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)
-    both("spmm-gather", type=str, default="native", choices=["native", "fp8"])
+    both("spmm-gather", type=str, default="native", choices=["native", "fp8", "int8"])
     both("spmm-dense", type=str, default="native", choices=["native", "int8"])
     both("block-occupancy", type=int, default=512)
     both("block-tile-budget-mb", type=int, default=2048)

@@ -254,9 +254,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             ell_arrays.update(gat_arrays)
             gat_keys = tuple(gat_arrays.keys())
 
-    if cfg.spmm_gather == "fp8" and ell_spmm is None and jax.process_index() == 0:
+    if cfg.spmm_gather != "native" and ell_spmm is None and jax.process_index() == 0:
         import sys
-        print(f"spmm_gather=fp8 has no effect for spmm={cfg.spmm!r} / "
+        print(f"spmm_gather={cfg.spmm_gather} has no effect for spmm={cfg.spmm!r} / "
               f"model={spec.model!r} (only the ell/hybrid GCN/GraphSAGE "
               f"aggregation paths quantize gathers)", file=sys.stderr)
 

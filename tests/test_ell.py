@@ -124,9 +124,15 @@ def test_build_ell_numpy_basics():
     np.testing.assert_allclose(np.asarray(out), a @ h, atol=1e-6)
 
 
-def test_fp8_gather_close_to_native():
-    """gather_dtype='fp8' ELL SpMM is within e4m3 tolerance of native,
-    forward and backward, and is not a silent no-op."""
+import pytest
+
+
+@pytest.mark.parametrize("qmode", ["fp8", "int8"])
+def test_quantized_gather_close_to_native(qmode):
+    """gather_dtype='fp8'/'int8' ELL SpMM is within quantization tolerance
+    of native, forward and backward, and is not a silent no-op. int8 is the
+    v5e-native 1-byte wire (fp8 decode is emulated and measured slower than
+    bf16 on hardware); its bucket sums run exactly in int32."""
     import jax
     import jax.numpy as jnp
     from bnsgcn_tpu.data.artifacts import build_artifacts
@@ -144,14 +150,14 @@ def test_fp8_gather_close_to_native():
     cot = jnp.asarray(rng.normal(size=(art.pad_inner, 16)), jnp.float32)
     a0 = {k: jnp.asarray(v[0]) for k, v in arrays.items()}
     outs, grads = {}, {}
-    for mode in ("native", "fp8"):
+    for mode in ("native", qmode):
         spmm = make_ell_spmm(f_spec, b_spec, len(f_spec.widths),
                              len(b_spec.widths), gather_dtype=mode)
         outs[mode] = np.asarray(spmm(a0, h))
         grads[mode] = np.asarray(jax.grad(
             lambda hh: jnp.sum(spmm(a0, hh) * cot))(h))
     scale = np.abs(outs["native"]).max() + 1e-9
-    assert np.abs(outs["fp8"] - outs["native"]).max() / scale < 0.05
-    assert not np.allclose(outs["fp8"], outs["native"])   # really quantized
+    assert np.abs(outs[qmode] - outs["native"]).max() / scale < 0.05
+    assert not np.allclose(outs[qmode], outs["native"])   # really quantized
     gscale = np.abs(grads["native"]).max() + 1e-9
-    assert np.abs(grads["fp8"] - grads["native"]).max() / gscale < 0.05
+    assert np.abs(grads[qmode] - grads["native"]).max() / gscale < 0.05

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Phase-2 hardware session: waits for tpu_watchdog.sh to finish its two
+# headline benches (DONE in /tmp/tpu_status), then runs the remaining
+# measurement stages in risk order — tune/trace/comm/microbench first,
+# the tunnel-wedging-risk Pallas probes last, and (only if the probes
+# survive) the hybrid+pallas bench candidate as the final act.
+cd /root/repo
+DEADLINE=$(( $(date +%s) + ${1:-28800} ))
+
+# Gate on a DONE appended AFTER this script started: /tmp/tpu_status is
+# append-only across sessions, so a stale DONE from a previous run must not
+# fire phase-2 while today's phase-1 benches still hold the TPU.
+N0=$(wc -l < /tmp/tpu_status 2>/dev/null || echo 0)
+
+phase1_done() {
+  tail -n +"$((N0 + 1))" /tmp/tpu_status 2>/dev/null | grep -q "^DONE$"
+}
+
+while ! phase1_done; do
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "phase2: benches never finished" >> /tmp/tpu_status2; exit 1
+  fi
+  sleep 120
+done
+
+alive() {
+  timeout 180 python -c \
+    "import jax; assert jax.devices() and jax.default_backend() == 'tpu'" \
+    >/dev/null 2>&1
+}
+
+wait_alive() {
+  while ! alive; do
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+      echo "phase2: TPU never came back" >> /tmp/tpu_status2; exit 1
+    fi
+    echo "phase2: TPU down at $(date -u +%H:%M:%S)" >> /tmp/tpu_status2
+    sleep 120
+  done
+}
+
+wait_alive
+timeout 7200 python tools/hw_session.py --skip live,bench \
+  > /tmp/hw_session_p2.log 2>&1
+echo "phase2: hw_session rc=$?" >> /tmp/tpu_status2
+
+# Pallas, strictly last (a killed remote-compile has wedged the tunnel)
+wait_alive
+timeout 1800 python tools/hw_session.py --skip live,bench,tune,trace,comm,microbench \
+  --include pallas > /tmp/hw_pallas.log 2>&1
+rc=$?
+echo "phase2: pallas probes rc=$rc" >> /tmp/tpu_status2
+if [ "$rc" -eq 0 ] && grep -q "PALLAS GROUPED MATMUL OK" /tmp/hw_pallas.log; then
+  wait_alive
+  timeout 2400 python bench.py --epochs 8 --candidates hybrid+pallas \
+    --budget-s 1800 > /tmp/bench_hw_pallas.log 2>&1
+  echo "phase2: bench pallas rc=$?" >> /tmp/tpu_status2
+fi
+echo DONE >> /tmp/tpu_status2
