@@ -167,7 +167,7 @@ def create_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true")
     both("eval-device", type=str, default="host", choices=["host", "mesh"])
     both("halo-exchange", type=str, default="padded", choices=["padded", "shift"])
-    both("halo-wire", type=str, default="native", choices=["native", "bf16", "fp8"])
+    both("halo-wire", type=str, default="native", choices=["native", "bf16", "fp8", "int8"])
     both("streaming-artifacts", type=str, default="auto",
          choices=["auto", "always", "never"])
     both("feat-storage", type=str, default="float32",

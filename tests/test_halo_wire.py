@@ -79,25 +79,26 @@ def test_shift_equals_padded(rate):
     np.testing.assert_allclose(g_s, g_p, rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.parametrize("wire", ["fp8", "int8"])
 @pytest.mark.parametrize("strategy", ["padded", "shift"])
-def test_fp8_wire_close_to_native(strategy):
+def test_quantized_wire_close_to_native(strategy, wire):
     g, pid = _skewed_graph()
     art = build_artifacts(g, pid)
     mesh = make_parts_mesh(4)
     feat = art.feat.astype(np.float32)
     sp_nat, tb = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, 0.5,
                                 strategy=strategy)
-    sp_f8, _ = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, 0.5,
-                              strategy=strategy, wire="fp8")
+    sp_q, _ = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, 0.5,
+                             strategy=strategy, wire=wire)
     hx_n, g_n = _apply_and_grad(art, sp_nat, tb, mesh, feat)
-    hx_8, g_8 = _apply_and_grad(art, sp_f8, tb, mesh, feat)
-    # inner rows are untouched by the wire; halo rows quantized (e4m3 ~ 2-3
-    # significant digits with per-block scale)
+    hx_8, g_8 = _apply_and_grad(art, sp_q, tb, mesh, feat)
+    # inner rows are untouched by the wire; halo rows quantized (e4m3/int8
+    # ~ 2-3 significant digits with per-block scale)
     scale = np.abs(hx_n).max() + 1e-9
-    assert np.abs(hx_8 - hx_n).max() / scale < 0.05, "fp8 fwd too lossy"
+    assert np.abs(hx_8 - hx_n).max() / scale < 0.05, f"{wire} fwd too lossy"
     gscale = np.abs(g_n).max() + 1e-9
-    assert np.abs(g_8 - g_n).max() / gscale < 0.05, "fp8 bwd too lossy"
-    assert not np.allclose(hx_8, hx_n), "fp8 path appears to be a no-op"
+    assert np.abs(g_8 - g_n).max() / gscale < 0.05, f"{wire} bwd too lossy"
+    assert not np.allclose(hx_8, hx_n), f"{wire} path appears to be a no-op"
 
 
 def test_bf16_wire_close_to_native():

@@ -111,7 +111,7 @@ import numpy as np, jax, jax.numpy as jnp
 from bnsgcn_tpu.parallel.halo import make_halo_spec, wire_bytes
 n_b = np.array([[0, 50000], [48000, 0]])
 for strat in ("padded", "shift"):
-    for wire in ("native", "bf16", "fp8"):
+    for wire in ("native", "bf16", "fp8", "int8"):
         sp, _ = make_halo_spec(n_b, 0, 50048, 0.1, strategy=strat, wire=wire)
         print(f"{strat}/{wire}: {wire_bytes(sp, 256, 2)/1e6:.2f} MB/exchange",
               flush=True)
