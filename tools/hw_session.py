@@ -151,6 +151,14 @@ def main():
             "hybrid occupancy 1024",
             [py, "bench.py", "--no-pallas", "--occupancy", "1024",
              "--epochs", str(args.epochs)], 2400)
+        # int8 residual-gather vs MXU-tile break-even sits near ~1000
+        # edges/tile; the 2 GB budget capped dcsbm coverage at 79% (8192
+        # tiles), so a 4 GB budget probes whether more MXU coverage wins
+        results["tune_tb4096"] = run(
+            "hybrid tile budget 4 GB",
+            [py, "bench.py", "--no-pallas", "--tile-budget-mb", "4096",
+             "--epochs", str(args.epochs),
+             "--candidates", "hybrid+i8g+i8d,hybrid"], 2400)
     if "trace" not in skip:
         results["trace"] = run(
             "profiler trace (Comm cross-check)",
