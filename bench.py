@@ -153,7 +153,8 @@ def main():
                     help="comma list restricting/ordering the SpMM variants "
                          "to measure after the ell anchor (names as logged: "
                          "hybrid, hybrid+i8g+i8d, hybrid+f8g+i8d, hybrid+f8g, "
-                         "ell+i8g, ell+f8g, hybrid+pallas) — for short TPU-tunnel windows")
+                         "ell+i8g, ell+f8g, hybrid+pallas, hybrid+pallas+i8g)"
+                         " — for short TPU-tunnel windows")
     args = ap.parse_args()
     t_start = time.time()
 
@@ -296,6 +297,8 @@ def main():
                 ("ell", False, "fp8", "native")]
     if jax.default_backend() == "tpu" and not args.no_pallas:
         universe.append(("hybrid", True, "native", "native"))
+        # fused Pallas dense tiles + native-convert 1-byte residual gathers
+        universe.append(("hybrid", True, "int8", "native"))
     anchor = ("ell", False, "native", "native")
     if args.spmm == "hybrid":
         candidates = [anchor] + universe

@@ -52,7 +52,7 @@ rc=$?
 echo "phase2: pallas probes rc=$rc" >> /tmp/tpu_status2
 if [ "$rc" -eq 0 ] && grep -q "PALLAS GROUPED MATMUL OK" /tmp/hw_pallas.log; then
   wait_alive
-  timeout 2400 python bench.py --epochs 8 --candidates hybrid+pallas \
+  timeout 2400 python bench.py --epochs 8 --candidates hybrid+pallas,hybrid+pallas+i8g \
     --budget-s 1800 > /tmp/bench_hw_pallas.log 2>&1
   echo "phase2: bench pallas rc=$?" >> /tmp/tpu_status2
 fi
