@@ -242,11 +242,16 @@ def build_x_slabs(spec: BlockSpec, perm_src, h):
 
 
 def _tile_chunk_for(n_blocks: int, row_tile: int, width: int,
-                    budget_bytes: int = 256 << 20) -> int:
+                    budget_bytes: int = 768 << 20) -> int:
     """Tiles per scan chunk so the f32 per-tile partial product stays under
     `budget_bytes`. Without chunking, [B, TR, H] f32 partials at bench scale
     (B=8192, H=602 in the use_pp precompute) are 9.5 GB of HLO temp — over
-    a v5e's 16 GB HBM (observed OOM at jit(precompute))."""
+    a v5e's 16 GB HBM (observed OOM at jit(precompute)). The budget trades
+    peak temp against accumulator re-traffic: each scan iteration re-reads
+    and re-writes the [n_row_blocks+1, TR, H] carry (~120 MB at H=256), so
+    fewer/larger chunks cost less HBM bandwidth — 768 MB keeps the
+    width-602 precompute near 2 GB of live temps and the H=256 train step
+    at ~6 chunks (~1.4 GB of carry traffic per pass instead of ~3.8 GB)."""
     per_tile = row_tile * width * 4
     c = max(64, budget_bytes // per_tile)
     return int(min(n_blocks, c))
