@@ -59,6 +59,8 @@ def main():
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--feat", type=int, default=64)
     ap.add_argument("--workdir", type=str, default="/tmp/scale_proof")
+    ap.add_argument("--method", type=str, default="random",
+                    choices=["random", "native"])
     args = ap.parse_args()
 
     t0 = time.time()
@@ -67,9 +69,21 @@ def main():
           f"(rss {rss_gb():.1f} GB)", flush=True)
     assert g.n_edges >= 100_000_000
 
-    from bnsgcn_tpu.data.partitioner import random_partition
-    pid = random_partition(g, args.parts, seed=0)
-    print(f"[{time.time()-t0:7.1f}s] partitioned (random, P={args.parts})", flush=True)
+    if args.method == "native":
+        # the METIS-role partitioner at papers100M scale (SURVEY §7 hard
+        # part d: the reference needs a 120 GB host for DGL/METIS here)
+        from bnsgcn_tpu.native import native_partition
+        t1 = time.time()
+        pid = native_partition(g, args.parts, obj="vol", seed=0,
+                               refine_passes=1, n_seeds=1)
+        assert pid is not None, "native partitioner unavailable"
+        print(f"[{time.time()-t0:7.1f}s] partitioned (native vol, "
+              f"P={args.parts}) in {time.time()-t1:.1f}s "
+              f"(rss {rss_gb():.1f} GB)", flush=True)
+    else:
+        from bnsgcn_tpu.data.partitioner import random_partition
+        pid = random_partition(g, args.parts, seed=0)
+        print(f"[{time.time()-t0:7.1f}s] partitioned (random, P={args.parts})", flush=True)
 
     from bnsgcn_tpu.data.artifacts import build_artifacts_streaming
     path = os.path.join(args.workdir, "artifacts")
