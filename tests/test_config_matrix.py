@@ -133,3 +133,39 @@ def test_one_step_finite_halo_variants(graph, model, spmm, halo_exchange,
             params, state, opt, jnp.uint32(e), blk, tb,
             jax.random.key(0), jax.random.key(1))
     assert np.isfinite(float(loss)), (model, spmm, halo_exchange, halo_wire)
+
+
+def test_one_step_finite_all_int8_recipe(graph):
+    """The all-int8 TPU recipe: hybrid SpMM with int8 residual gathers +
+    int8 MXU dense tiles + int8 halo wire + shift exchange, bf16 compute —
+    the preferred narrow-format stack on v5e (e4m3 decode is emulated and
+    measured slower; see BENCH_NOTES.md)."""
+    g = graph
+    cfg = Config(model="graphsage", dropout=0.2, use_pp=True, norm="layer",
+                 spmm="hybrid", dtype="bfloat16", halo_exchange="shift",
+                 halo_wire="int8", spmm_gather="int8", spmm_dense="int8",
+                 n_train=g.n_train, lr=0.01, sampling_rate=0.5)
+    sizes = (6, 8, 8, 3)
+    spec = ModelSpec("graphsage", sizes, norm="layer", dropout=0.2,
+                     use_pp=True, train_size=g.n_train)
+    mesh = make_parts_mesh(4)
+    art = build_artifacts(g, partition_graph(g, 4, method="random", seed=7))
+    fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
+    blk_np = build_block_arrays(art, "graphsage")
+    blk_np.update(fns.extra_blk)
+    for k in fns.drop_blk_keys:
+        blk_np.pop(k, None)
+    blk = place_blocks(blk_np, mesh)
+    blk["feat"] = blk["feat"].astype(jnp.bfloat16)
+    tb = place_replicated(tables, mesh)
+    blk["feat"] = fns.precompute(
+        blk, place_replicated(tables_full, mesh)).astype(jnp.bfloat16)
+    params, state = init_params(jax.random.key(0), spec, dtype=jnp.bfloat16)
+    params = place_replicated(params, mesh)
+    state = place_replicated(state, mesh)
+    _, _, opt = init_training(cfg, spec, mesh, dtype=jnp.bfloat16)
+    for e in range(2):
+        params, state, opt, loss = fns.train_step(
+            params, state, opt, jnp.uint32(e), blk, tb,
+            jax.random.key(0), jax.random.key(1))
+    assert np.isfinite(float(loss))
