@@ -295,17 +295,8 @@ def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h,
 
     n_seg = spec.n_row_blocks + 1
     C = _tile_chunk_for(B, spec.row_tile, H)
-    pad = (-B) % C
-    if pad:
-        # zero tiles routed to the dump segment keep rowb sorted
-        tiles = jnp.concatenate(
-            [tiles, jnp.zeros((pad,) + tiles.shape[1:], tiles.dtype)], 0)
-        rowb = jnp.concatenate(
-            [rowb, jnp.full((pad,), spec.n_row_blocks, rowb.dtype)], 0)
-        colb = jnp.concatenate([colb, jnp.zeros((pad,), colb.dtype)], 0)
-    n_chunks = (B + pad) // C
-    xs = (tiles.reshape(n_chunks, C, *tiles.shape[1:]),
-          rowb.reshape(n_chunks, C), colb.reshape(n_chunks, C))
+    n_full = B // C                       # >= 1: C = min(B, ...) above
+    rem = B - n_full * C
 
     def body(acc, x):
         tiles_c, rowb_c, colb_c = x
@@ -314,6 +305,15 @@ def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h,
                                 indices_are_sorted=True)
         return acc + s, None
 
+    # full chunks go through the scan as a prefix-slice + reshape (both
+    # copy-free in XLA); the B%C remainder runs as ONE extra, smaller
+    # segment-sum below instead of zero-padding the whole tile stack —
+    # the old pad-concatenate materialized a transient copy of the stack
+    # (~2 GB at bench scale) inside jit whenever B wasn't a chunk multiple
+    xs = (tiles[:n_full * C].reshape(n_full, C, *tiles.shape[1:]),
+          rowb[:n_full * C].reshape(n_full, C),
+          colb[:n_full * C].reshape(n_full, C))
+
     # derive the init carry from the input so it carries the same varying
     # manual axes as the body output under shard_map (scan rejects an
     # unvarying zeros init against a parts-varying accumulator); the empty
@@ -321,6 +321,10 @@ def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h,
     acc0 = jnp.zeros((n_seg, spec.row_tile, H), jnp.float32) \
         + jnp.sum(x_perm[:0]).astype(jnp.float32)
     seg, _ = jax.lax.scan(body, acc0, xs)
+    if rem:
+        seg = seg + jax.ops.segment_sum(
+            chunk_prod(tiles[n_full * C:], colb[n_full * C:]),
+            rowb[n_full * C:], num_segments=n_seg, indices_are_sorted=True)
     seg = seg[:spec.n_row_blocks]
     flat = seg.reshape(spec.n_row_blocks * spec.row_tile, H).astype(h.dtype)
     return flat[perm_out]                                  # original row order

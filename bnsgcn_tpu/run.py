@@ -391,8 +391,13 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
         if (epoch + 1) % cfg.log_every == 0:
             mt, mc, mr = timer.means()
-            log("Process 000 | Epoch {:05d} | Time(s) {:.4f} | Comm(s) {:.4f} | "
-                "Reduce(s) {:.4f} | Loss {:.4f}".format(epoch, mt, mc, mr, float(loss)))
+            # Comm(s) is an exchange-only microbench at the training compute
+            # dtype, sampled on log_every epochs and held between samples —
+            # the "[sampled]" tag keeps it from reading as a per-epoch
+            # in-step measurement like the reference's comm_timer
+            log("Process 000 | Epoch {:05d} | Time(s) {:.4f} | Comm(s) {:.4f} "
+                "[sampled] | Reduce(s) {:.4f} | Loss {:.4f}".format(
+                    epoch, mt, mc, mr, float(loss)))
 
         if (epoch + 1) % cfg.log_every == 0 and is_rank0:
             # periodic checkpoint regardless of eval, so --no-eval runs resume

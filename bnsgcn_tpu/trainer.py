@@ -378,7 +378,11 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     def local_exchange_only(blk, tables, epoch, sample_key, width):
         blk = {k: v[0] for k, v in blk.items()}
         plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
-        h = jnp.zeros((hspec.pad_inner, width), dtype=jnp.float32)
+        # the payload must be the TRAINING compute dtype: with
+        # --dtype bfloat16 --halo-wire native the wire ships bf16, and an
+        # f32 microbench payload would report 2x the training step's bytes
+        comm_dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        h = jnp.zeros((hspec.pad_inner, width), dtype=comm_dtype)
         out = halo_apply(hspec, plan, h)
         return jnp.sum(out)[None]
 

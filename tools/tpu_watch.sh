@@ -1,0 +1,17 @@
+#!/bin/bash
+# TPU tunnel watcher: probe the backend every 60s for up to ~9.5 min.
+# Exit 0 the moment a TPU backend answers; exit 2 if the window stayed shut.
+# Launched repeatedly in the background so work can proceed while waiting.
+DEADLINE=$((SECONDS + 540))
+while [ $SECONDS -lt $DEADLINE ]; do
+  out=$(timeout 100 python -c "import jax; jax.devices(); print(jax.default_backend())" 2>/dev/null | tail -1)
+  ts=$(date +%H:%M:%S)
+  if [ "$out" = "tpu" ]; then
+    echo "$ts TPU UP"
+    exit 0
+  fi
+  echo "$ts probe failed (got: '$out')"
+  sleep 50
+done
+echo "window closed; tunnel still down"
+exit 2
