@@ -151,9 +151,275 @@ int64_t edge_cut_of(const Csr& uni, const int32_t* part) {
   return cut / 2;  // union CSR holds both directions
 }
 
+// ---------------------------------------------------------------------------
+// multilevel machinery: HEM coarsening + weighted LDG/FM. The classic
+// multilevel scheme (coarsen, partition the small graph where FM moves are
+// global, project back, refine locally at each level) sees community
+// structure the single-level streaming pass cannot: a whole cluster is one
+// coarse vertex, so the initial partition never splits it by accident.
+// ---------------------------------------------------------------------------
+
+// Weighted undirected graph. Empty wgt/vwgt mean "all ones".
+struct WGraph {
+  std::vector<int64_t> indptr;
+  std::vector<int64_t> adj;
+  std::vector<int32_t> wgt;   // edge weights (parallel to adj)
+  std::vector<int32_t> vwgt;  // vertex weights
+};
+
+// Non-owning view: level 0 is the caller's union CSR with implicit unit
+// weights — at papers100M scale a deep copy would cost GBs.
+struct WView {
+  const int64_t* indptr;
+  const int64_t* adj;
+  const int32_t* wgt;    // nullptr = all ones
+  const int32_t* vwgt;   // nullptr = all ones
+  int64_t n_v;
+
+  int64_t n() const { return n_v; }
+  int32_t ew(int64_t i) const { return wgt ? wgt[i] : 1; }
+  int32_t vw(int64_t v) const { return vwgt ? vwgt[v] : 1; }
+};
+
+WView view_of(const WGraph& g) {
+  return {g.indptr.data(), g.adj.data(),
+          g.wgt.empty() ? nullptr : g.wgt.data(),
+          g.vwgt.empty() ? nullptr : g.vwgt.data(),
+          static_cast<int64_t>(g.indptr.size()) - 1};
+}
+
+WView view_of(const Csr& g) {
+  return {g.indptr.data(), g.adj.data(), nullptr, nullptr,
+          static_cast<int64_t>(g.indptr.size()) - 1};
+}
+
+// Heavy-edge matching: each unmatched vertex (random visit order) pairs with
+// its heaviest unmatched neighbor whose combined weight stays under
+// max_vwgt; singletons self-match. Returns the coarse graph and fills
+// cmap[fine] = coarse id.
+WGraph hem_coarsen(const WView& g, std::vector<int64_t>& cmap,
+                   int32_t max_vwgt, std::mt19937_64& rng) {
+  const int64_t n = g.n();
+  cmap.assign(n, -1);
+  std::vector<int64_t> order(n);
+  for (int64_t v = 0; v < n; ++v) order[v] = v;
+  std::shuffle(order.begin(), order.end(), rng);
+  int64_t nc = 0;
+  std::vector<int64_t> match(n, -1);
+  for (int64_t v : order) {
+    if (match[v] >= 0) continue;
+    int64_t best_u = -1;
+    int32_t best_w = 0;
+    for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+      int64_t u = g.adj[i];
+      if (u == v || match[u] >= 0) continue;
+      if (g.vw(v) + g.vw(u) > max_vwgt) continue;
+      if (g.ew(i) > best_w) { best_w = g.ew(i); best_u = u; }
+    }
+    match[v] = v;
+    if (best_u >= 0) match[best_u] = v;
+    cmap[v] = nc;
+    if (best_u >= 0) cmap[best_u] = nc;
+    ++nc;
+  }
+
+  WGraph c;
+  c.indptr.assign(nc + 1, 0);
+  c.vwgt.assign(nc, 0);
+  for (int64_t v = 0; v < n; ++v) c.vwgt[cmap[v]] += g.vw(v);
+  // counting-sort membership (coarse id -> fine members): flat arrays, no
+  // per-vertex vector allocations
+  std::vector<int64_t> moff(nc + 1, 0), morder(n);
+  for (int64_t v = 0; v < n; ++v) ++moff[cmap[v] + 1];
+  for (int64_t cv = 0; cv < nc; ++cv) moff[cv + 1] += moff[cv];
+  {
+    std::vector<int64_t> fill(moff.begin(), moff.end() - 1);
+    for (int64_t v = 0; v < n; ++v) morder[fill[cmap[v]]++] = v;
+  }
+  // accumulate coarse adjacency with a scratch map (touched-list trick)
+  std::vector<int32_t> scratch(nc, 0);
+  std::vector<int64_t> touched;
+  for (int64_t cv = 0; cv < nc; ++cv) {        // sizing pass
+    touched.clear();
+    for (int64_t k = moff[cv]; k < moff[cv + 1]; ++k) {
+      int64_t v = morder[k];
+      for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+        int64_t cu = cmap[g.adj[i]];
+        if (cu == cv) continue;
+        if (scratch[cu] == 0) touched.push_back(cu);
+        scratch[cu] += g.ew(i);
+      }
+    }
+    c.indptr[cv + 1] = c.indptr[cv] + static_cast<int64_t>(touched.size());
+    for (int64_t cu : touched) scratch[cu] = 0;
+  }
+  c.adj.resize(c.indptr[nc]);
+  c.wgt.resize(c.indptr[nc]);
+  int64_t w = 0;
+  for (int64_t cv = 0; cv < nc; ++cv) {
+    touched.clear();
+    for (int64_t k = moff[cv]; k < moff[cv + 1]; ++k) {
+      int64_t v = morder[k];
+      for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+        int64_t cu = cmap[g.adj[i]];
+        if (cu == cv) continue;
+        if (scratch[cu] == 0) touched.push_back(cu);
+        scratch[cu] += g.ew(i);
+      }
+    }
+    for (int64_t cu : touched) {
+      c.adj[w] = cu;
+      c.wgt[w++] = scratch[cu];
+      scratch[cu] = 0;
+    }
+  }
+  return c;
+}
+
+// Weighted LDG streaming assignment (BFS order) — phase-1 analog on a
+// weighted (coarse) graph: score = edge weight into part x fill discount,
+// balance on vertex weight.
+void ldg_assign_weighted(const WView& g, int32_t n_parts, int64_t cap,
+                         std::mt19937_64& rng, int32_t* part) {
+  const int64_t n = g.n();
+  std::vector<int64_t> size(n_parts, 0);
+  std::vector<int64_t> order(n);
+  for (int64_t v = 0; v < n; ++v) order[v] = v;
+  std::shuffle(order.begin(), order.end(), rng);
+  std::vector<int64_t> nbr_w(n_parts, 0);
+  std::vector<int32_t> touched;
+  std::queue<int64_t> bfs;
+  std::vector<uint8_t> queued(n, 0);
+  int64_t cursor = 0, assigned = 0;
+  std::fill_n(part, n, -1);
+  while (assigned < n) {
+    if (bfs.empty()) {
+      while (cursor < n && part[order[cursor]] >= 0) ++cursor;
+      if (cursor >= n) break;
+      queued[order[cursor]] = 1;
+      bfs.push(order[cursor]);
+    }
+    int64_t v = bfs.front();
+    bfs.pop();
+    if (part[v] >= 0) continue;
+    touched.clear();
+    for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+      int32_t p = part[g.adj[i]];
+      if (p >= 0) {
+        if (nbr_w[p] == 0) touched.push_back(p);
+        nbr_w[p] += g.ew(i);
+      }
+    }
+    double best_score = -1.0;
+    int32_t best_p = -1;
+    for (int32_t p : touched) {
+      if (size[p] + g.vw(v) > cap) continue;
+      double score = static_cast<double>(nbr_w[p]) *
+                     (1.0 - static_cast<double>(size[p]) / cap);
+      if (score > best_score) { best_score = score; best_p = p; }
+    }
+    if (best_p < 0) {
+      int64_t min_sz = INT64_MAX;
+      for (int32_t p = 0; p < n_parts; ++p)
+        if (size[p] < min_sz) { min_sz = size[p]; best_p = p; }
+    }
+    for (int32_t p : touched) nbr_w[p] = 0;
+    part[v] = best_p;
+    size[best_p] += g.vw(v);
+    for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+      int64_t u = g.adj[i];
+      if (part[u] < 0 && !queued[u]) { queued[u] = 1; bfs.push(u); }
+    }
+    ++assigned;
+  }
+}
+
+// Weighted FM cut refinement (boundary moves, weighted gain, vwgt balance).
+void fm_refine_weighted(const WView& g, int32_t n_parts, int64_t soft_cap,
+                        int32_t passes, int32_t* part,
+                        std::vector<int64_t>& size) {
+  const int64_t n = g.n();
+  std::vector<int64_t> adj_w(n_parts, 0);
+  std::vector<int32_t> touched;
+  for (int32_t pass = 0; pass < passes; ++pass) {
+    int64_t moves = 0;
+    for (int64_t v = 0; v < n; ++v) {
+      int32_t pv = part[v];
+      touched.clear();
+      bool boundary = false;
+      for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+        int32_t p = part[g.adj[i]];
+        if (adj_w[p] == 0) touched.push_back(p);
+        adj_w[p] += g.ew(i);
+        if (p != pv) boundary = true;
+      }
+      if (boundary && size[pv] > g.vw(v)) {
+        int64_t best_gain = 0;
+        int32_t best_p = -1;
+        for (int32_t q : touched) {
+          if (q == pv || size[q] + g.vw(v) > soft_cap) continue;
+          int64_t gain = adj_w[q] - adj_w[pv];
+          if (gain > best_gain) { best_gain = gain; best_p = q; }
+        }
+        if (best_p >= 0) {
+          part[v] = best_p;
+          size[pv] -= g.vw(v);
+          size[best_p] += g.vw(v);
+          ++moves;
+        }
+      }
+      for (int32_t p : touched) adj_w[p] = 0;
+    }
+    if (moves == 0) break;
+  }
+}
+
+// Push vertices out of over-cap parts (least-cut-harm boundary moves first,
+// then any vertex) until every part is under hard_cap. Unit weights — runs
+// at the finest level only.
+void rebalance(const Csr& g, int32_t n_parts, int64_t hard_cap,
+               int32_t* part, std::vector<int64_t>& size) {
+  const int64_t n = static_cast<int64_t>(g.indptr.size()) - 1;
+  std::vector<int64_t> adj_in_part(n_parts, 0);
+  std::vector<int32_t> touched;
+  for (int32_t round = 0; round < 64; ++round) {
+    bool over = false;
+    for (int32_t p = 0; p < n_parts; ++p) over |= (size[p] > hard_cap);
+    if (!over) return;
+    for (int64_t v = 0; v < n; ++v) {
+      int32_t pv = part[v];
+      if (size[pv] <= hard_cap) continue;
+      touched.clear();
+      for (int64_t i = g.indptr[v]; i < g.indptr[v + 1]; ++i) {
+        int32_t p = part[g.adj[i]];
+        if (adj_in_part[p] == 0) touched.push_back(p);
+        ++adj_in_part[p];
+      }
+      int64_t best_gain = INT64_MIN;
+      int32_t best_p = -1;
+      for (int32_t q = 0; q < n_parts; ++q) {
+        if (q == pv || size[q] >= hard_cap) continue;
+        int64_t gain = adj_in_part[q] - adj_in_part[pv];
+        if (gain > best_gain) { best_gain = gain; best_p = q; }
+      }
+      for (int32_t p : touched) adj_in_part[p] = 0;
+      if (best_p >= 0) {
+        part[v] = best_p;
+        --size[pv];
+        ++size[best_p];
+      }
+    }
+  }
+}
+
 // hubs fall back to the cut gain: their exact vol delta costs
 // O(in_deg * candidates) lookups and they rarely move profitably
 constexpr int64_t kVolScanCap = 512;
+
+void refine_true(int64_t n_nodes, const Csr& g, const Csr* out_csr,
+                 const Csr* in_csr, int32_t n_parts, int32_t objective,
+                 int32_t refine_passes, int32_t* part_p,
+                 std::vector<int64_t>& size, int64_t cap);
 
 void partition_once(int64_t n_nodes, const Csr& g, const Csr* out_csr,
                     const Csr* in_csr, int32_t n_parts, int32_t objective,
@@ -221,6 +487,21 @@ void partition_once(int64_t n_nodes, const Csr& g, const Csr* out_csr,
   }
 
   // ---- phase 2: FM-lite boundary refinement ----
+  refine_true(n_nodes, g, out_csr, in_csr, n_parts, objective, refine_passes,
+              part.data(), size, cap);
+  std::memcpy(part_out, part.data(), sizeof(int32_t) * n_nodes);
+}
+
+// FM-lite refinement against the TRUE objective (directed comm volume for
+// 'vol' with exact own+neighbor halo-set deltas, weighted only by the
+// unit-weight finest graph; edge cut otherwise). Shared by the flat and
+// multilevel pipelines.
+void refine_true(int64_t n_nodes, const Csr& g, const Csr* out_csr,
+                 const Csr* in_csr, int32_t n_parts, int32_t objective,
+                 int32_t refine_passes, int32_t* part_p,
+                 std::vector<int64_t>& size, int64_t cap) {
+  std::vector<int32_t> part(part_p, part_p + n_nodes);
+  std::vector<int32_t> touched;
   std::vector<int64_t> adj_in_part(n_parts, 0);
   const double slack = 1.02;  // allow 2% imbalance during refinement
   const int64_t soft_cap = static_cast<int64_t>(cap * slack);
@@ -286,6 +567,67 @@ void partition_once(int64_t n_nodes, const Csr& g, const Csr* out_csr,
     if (moves == 0) break;
   }
 
+  std::memcpy(part_p, part.data(), sizeof(int32_t) * n_nodes);
+}
+
+// Multilevel pipeline: HEM-coarsen to ~max(256, 24*P) vertices, weighted
+// LDG + weighted FM on the coarsest graph, project up with per-level
+// weighted FM, then the true-objective refinement + hard rebalance at the
+// finest level. Same output contract as partition_once (balance cap
+// ceil(n/P)*1.02 is enforced by rebalance()).
+void partition_multilevel(int64_t n_nodes, const Csr& uni, const Csr* out_csr,
+                          const Csr* in_csr, int32_t n_parts,
+                          int32_t objective, uint64_t seed,
+                          int32_t refine_passes, int32_t* part_out) {
+  std::mt19937_64 rng(seed);
+  // level 0 borrows the union CSR as a view (unit weights, zero copies);
+  // coarse levels own their graphs
+  std::vector<WGraph> coarse;
+  std::vector<WView> levels = {view_of(uni)};
+  std::vector<std::vector<int64_t>> cmaps;
+  const int64_t target = std::max<int64_t>(256, 24 * n_parts);
+  const int32_t max_vwgt = static_cast<int32_t>(std::max<int64_t>(
+      1, n_nodes / (8 * n_parts)));
+  while (levels.back().n() > target) {
+    std::vector<int64_t> cmap;
+    WGraph c = hem_coarsen(levels.back(), cmap, max_vwgt, rng);
+    if (c.indptr.size() - 1 >
+        static_cast<size_t>(levels.back().n()) * 95 / 100)
+      break;                                           // matching stalled
+    cmaps.push_back(std::move(cmap));
+    coarse.push_back(std::move(c));
+    levels.push_back(view_of(coarse.back()));
+  }
+
+  // initial partition on the coarsest level: weighted LDG + deep weighted FM
+  const WView& coarsest = levels.back();
+  const int64_t cap = (n_nodes + n_parts - 1) / n_parts;
+  const int64_t soft_cap = static_cast<int64_t>(cap * 1.02);
+  std::vector<int32_t> part(coarsest.n());
+  ldg_assign_weighted(coarsest, n_parts, soft_cap, rng, part.data());
+  std::vector<int64_t> size(n_parts, 0);
+  for (int64_t v = 0; v < coarsest.n(); ++v) size[part[v]] += coarsest.vw(v);
+  fm_refine_weighted(coarsest, n_parts, soft_cap, 16, part.data(), size);
+
+  // uncoarsen: project, then local weighted FM at every level
+  for (int64_t lvl = static_cast<int64_t>(levels.size()) - 2; lvl >= 0;
+       --lvl) {
+    const std::vector<int64_t>& cmap = cmaps[lvl];
+    const WView& g = levels[lvl];
+    std::vector<int32_t> fine(g.n());
+    for (int64_t v = 0; v < g.n(); ++v) fine[v] = part[cmap[v]];
+    part.swap(fine);
+    std::fill(size.begin(), size.end(), 0);
+    for (int64_t v = 0; v < g.n(); ++v) size[part[v]] += g.vw(v);
+    fm_refine_weighted(g, n_parts, soft_cap, lvl == 0 ? 1 : 3, part.data(),
+                       size);
+  }
+
+  // finest level: hard balance, then the true-objective refinement
+  rebalance(uni, n_parts, soft_cap, part.data(), size);
+  refine_true(n_nodes, uni, out_csr, in_csr, n_parts, objective,
+              refine_passes, part.data(), size, cap);
+  rebalance(uni, n_parts, soft_cap, part.data(), size);
   std::memcpy(part_out, part.data(), sizeof(int32_t) * n_nodes);
 }
 
@@ -294,11 +636,13 @@ void partition_once(int64_t n_nodes, const Csr& g, const Csr* out_csr,
 extern "C" {
 
 // Returns 0 on success. out_part must hold n_nodes int32. n_seeds > 1 runs
-// the pipeline per seed and keeps the partition with the best true objective.
-int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
-                  const int64_t* dst, int32_t n_parts, int32_t objective,
-                  uint64_t seed, int32_t refine_passes, int32_t n_seeds,
-                  int32_t* out_part) {
+// the pipeline per seed and keeps the partition with the best true
+// objective. multilevel != 0 selects the HEM-coarsen pipeline (better
+// quality on clustered graphs); 0 the flat LDG+FM one.
+int bns_partition_v2(int64_t n_nodes, int64_t n_edges, const int64_t* src,
+                     const int64_t* dst, int32_t n_parts, int32_t objective,
+                     uint64_t seed, int32_t refine_passes, int32_t n_seeds,
+                     int32_t multilevel, int32_t* out_part) {
   if (n_parts <= 0 || n_nodes <= 0) return 1;
   if (n_parts == 1) {
     std::memset(out_part, 0, sizeof(int32_t) * n_nodes);
@@ -315,10 +659,21 @@ int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
   std::vector<int32_t> cand(n_nodes);
   int64_t best_obj = INT64_MAX;
   for (int32_t s = 0; s < n_seeds; ++s) {
-    partition_once(n_nodes, g, vol ? &out_csr : nullptr,
-                   vol ? &in_csr : nullptr, n_parts, objective,
-                   seed + static_cast<uint64_t>(s) * 0x9e3779b97f4a7c15ULL,
-                   refine_passes, cand.data());
+    const uint64_t sd =
+        seed + static_cast<uint64_t>(s) * 0x9e3779b97f4a7c15ULL;
+    // multilevel mode keeps one flat candidate (the last seed) in the
+    // best-of pool: on structure-free graphs coarsening has nothing to
+    // exploit and the flat streaming pass can win by a few percent
+    const bool use_ml = multilevel && (n_seeds == 1 || s < n_seeds - 1);
+    if (use_ml) {
+      partition_multilevel(n_nodes, g, vol ? &out_csr : nullptr,
+                           vol ? &in_csr : nullptr, n_parts, objective, sd,
+                           refine_passes, cand.data());
+    } else {
+      partition_once(n_nodes, g, vol ? &out_csr : nullptr,
+                     vol ? &in_csr : nullptr, n_parts, objective, sd,
+                     refine_passes, cand.data());
+    }
     int64_t obj = vol ? comm_volume_of(n_nodes, out_csr, cand.data(), n_parts)
                       : edge_cut_of(g, cand.data());
     if (obj < best_obj) {
@@ -327,6 +682,15 @@ int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
     }
   }
   return 0;
+}
+
+// Back-compat entry: the flat pipeline.
+int bns_partition(int64_t n_nodes, int64_t n_edges, const int64_t* src,
+                  const int64_t* dst, int32_t n_parts, int32_t objective,
+                  uint64_t seed, int32_t refine_passes, int32_t n_seeds,
+                  int32_t* out_part) {
+  return bns_partition_v2(n_nodes, n_edges, src, dst, n_parts, objective,
+                          seed, refine_passes, n_seeds, 0, out_part);
 }
 
 // Quality metrics for tests/logging (directed edge list).
