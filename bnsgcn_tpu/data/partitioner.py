@@ -110,5 +110,8 @@ def comm_volume(g: Graph, part_id: np.ndarray) -> int:
     has an out-edge into j — the payload of one full-rate halo exchange
     (obj='vol', what BNS actually compresses)."""
     cross = part_id[g.src] != part_id[g.dst]
-    pairs = np.stack([g.src[cross], part_id[g.dst[cross]].astype(np.int64)], 1)
-    return int(np.unique(pairs, axis=0).shape[0])
+    # unique (node, dst-part) pairs via a packed 1-D key: half the memory
+    # and no structured axis=0 sort — matters at 1e9-edge scale proofs
+    P = int(part_id.max()) + 1
+    key = g.src[cross] * np.int64(P) + part_id[g.dst[cross]].astype(np.int64)
+    return int(np.unique(key).shape[0])
