@@ -1,0 +1,91 @@
+"""Unit tests for tools/trace_comm.py's trace attribution logic.
+
+The parser feeds the Comm(s) fidelity cross-check (reference comm_timer
+semantics, helper/timer/comm_timer.py:21-25); these tests pin its three
+non-obvious behaviors on a synthetic chrome trace: nested-duplicate launch
+dedup, device-event -> host-program attribution by launch order, and the
+min-over-lanes wait-stripping estimate.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from trace_comm import attribute, program_cost  # noqa: E402
+
+
+def _meta(pid, tid, name):
+    return {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": name}}
+
+
+def _ev(pid, tid, name, ts, dur):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name,
+            "ts": ts, "dur": dur}
+
+
+def make_trace():
+    """Host lane launches train_step twice (each as a ~1us-apart duplicate
+    pair), then one exchange_only sweep of two back-to-back fires; two
+    device lanes carry collectives after each launch."""
+    ev = [_meta(1, 0, "python"), _meta(1, 10, "dev0"), _meta(1, 11, "dev1")]
+    # step 1 @ t=1000 (duplicate at 1000.5), step 2 @ t=5000 (+dup)
+    for t in (1000.0, 1000.5, 5000.0, 5000.5):
+        ev.append(_ev(1, 0, "PjitFunction(train_step)", t, 300))
+    # one microbench sweep: two consecutive fires @ 9000, 9500 (+dups)
+    for t in (9000.0, 9000.2, 9500.0, 9500.2):
+        ev.append(_ev(1, 0, "PjitFunction(exchange_only)", t, 100))
+    # device collectives: per step, one a2a per lane with asymmetric wait
+    # (lane0 waits: dur 50; lane1 arrives last: dur 10) + one all-reduce
+    for t0 in (1100.0, 5100.0):
+        ev.append(_ev(1, 10, "all-to-all.1", t0, 50))
+        ev.append(_ev(1, 11, "all-to-all.1", t0 + 40, 10))
+        ev.append(_ev(1, 10, "all-reduce.2", t0 + 60, 7))
+        ev.append(_ev(1, 11, "all-reduce.2", t0 + 60, 7))
+    # microbench fires: one a2a per lane per fire
+    for t0 in (9100.0, 9600.0):
+        ev.append(_ev(1, 10, "all-to-all.9", t0, 20))
+        ev.append(_ev(1, 11, "all-to-all.9", t0 + 15, 5))
+    # a collective before any launch lands in "other"
+    ev.append(_ev(1, 10, "all-gather.0", 10.0, 3))
+    return ev
+
+
+def test_launch_dedup_and_sweeps():
+    attr = attribute(make_trace())
+    assert attr["train_step"]["launches"] == 2
+    assert attr["exchange_only"]["launches"] == 2
+    assert attr["exchange_only"]["sweeps"] == 1
+
+
+def test_attribution_categories():
+    attr = attribute(make_trace())
+    raw, _, nev, nl = program_cost(attr["train_step"], "exchange")
+    assert nl == 2 and nev == 2          # 2 steps x 1 a2a per lane
+    assert raw == 2 * (50 + 10)
+    rraw, _, _, _ = program_cost(attr["train_step"], "reduce")
+    assert rraw == 2 * (7 + 7)
+    oraw, _, _, _ = program_cost(attr["other"], "reduce")
+    assert oraw == 3                     # pre-launch all-gather
+
+    mraw, _, mev, _ = program_cost(attr["exchange_only"], "exchange")
+    assert mev == 2 and mraw == 2 * (20 + 5)
+
+
+def test_min_over_lanes_strips_waiter():
+    attr = attribute(make_trace())
+    _, est, _, _ = program_cost(attr["train_step"], "exchange")
+    # per step the last-arriving lane's span (10) is the true cost
+    assert est == 2 * 10
+    _, mest, _, _ = program_cost(attr["exchange_only"], "exchange")
+    assert mest == 2 * 5
+
+
+def test_host_lane_collectives_ignored():
+    ev = make_trace()
+    ev.append(_ev(1, 0, "all-to-all.7", 1200.0, 999))   # python lane
+    attr = attribute(ev)
+    raw, _, _, _ = program_cost(attr["train_step"], "exchange")
+    assert raw == 2 * (50 + 10)

@@ -43,6 +43,14 @@ def run(name, cmd, timeout, env=None):
         p = subprocess.run(cmd, cwd=REPO, env=e, timeout=timeout,
                            capture_output=True, text=True)
         out = (p.stdout + p.stderr)
+        # full output to disk — an OOM allocation dump can be >100 KB and
+        # would otherwise evict the per-candidate result lines
+        logdir = os.path.join(REPO, "hw_logs")
+        os.makedirs(logdir, exist_ok=True)
+        with open(os.path.join(logdir,
+                               name.replace(" ", "_").replace("/", "_")
+                               + ".log"), "w") as f:
+            f.write(out)
         print(out[-6000:], flush=True)
         print(f"--- {name}: rc={p.returncode} in {time.time()-t0:.0f}s",
               flush=True)

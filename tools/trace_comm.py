@@ -216,7 +216,7 @@ def main():
         print(f"trace: {path}")
         attr = attribute(events)
         for prog in HOST_PROGRAMS + ("other",):
-            n = attr[prog]["launches"] if prog != "other" else 0
+            n = attr[prog]["launches"]
             for cat in ("exchange", "reduce"):
                 raw, est, nev, nl = program_cost(attr[prog], cat)
                 if nev == 0 and n == 0:
