@@ -66,7 +66,8 @@ class Config:
     dtype: str = "float32"              # compute dtype: 'float32' | 'bfloat16'
     edge_chunk: int = 0                 # >0: aggregate edges in chunks of this size (bounds HBM)
     spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'hybrid'
-                                        # (dense int8 MXU tiles + ELL residual) | 'segment'
+                                        # (dense int8 MXU tiles + ELL residual) | 'auto'
+                                        # (estimate tile coverage, pick hybrid/ell) | 'segment'
     use_pallas: bool = False            # use Pallas aggregation kernels where available
     spmm_gather: str = "native"         # 'native' | 'fp8' | 'int8': quantize SpMM gather rows to
                                         # e4m3 (+1 scale per call) — the gather unit is
@@ -162,7 +163,7 @@ def create_parser() -> argparse.ArgumentParser:
     # TPU-specific
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--spmm", type=str, default="ell",
-                   choices=["ell", "hybrid", "segment"])
+                   choices=["ell", "hybrid", "auto", "segment"])
     both("profile-dir", type=str, default="")
     p.add_argument("--remat", action="store_true")
     both("eval-device", type=str, default="host", choices=["host", "mesh"])

@@ -58,6 +58,47 @@ class BlockSpec:
     n_row_blocks: int              # ceil(n_rows / row_tile)
 
 
+def _select_dense(tile_id, occupancy_min, tile_budget_bytes,
+                  need_inverse=True):
+    """Which tiles densify: >= occupancy_min edges, highest-count tiles win
+    under the HBM budget (ties trimmed last). Shared by the real layout
+    build and the O(E) coverage estimator behind --spmm auto (which skips
+    the len(E) int64 inverse array — need_inverse=False)."""
+    if need_inverse:
+        uniq, inv, counts = np.unique(tile_id, return_inverse=True,
+                                      return_counts=True)
+    else:
+        uniq, counts = np.unique(tile_id, return_counts=True)
+        inv = None
+    max_tiles = max(int(tile_budget_bytes // (TR * TC)), 1)
+    dense_sel = counts >= occupancy_min
+    if int(dense_sel.sum()) > max_tiles:
+        # keep every tile strictly above the cut, trim only among ties
+        thresh = np.sort(counts[dense_sel])[-max_tiles]
+        above = counts > thresh
+        ties = np.nonzero(dense_sel & (counts == thresh))[0]
+        dense_sel = above
+        dense_sel[ties[:max_tiles - int(above.sum())]] = True
+    return uniq, inv, counts, dense_sel
+
+
+def estimate_coverage(perm_rows, perm_cols, n_rows, n_src, rows, cols,
+                      occupancy_min=512, tile_budget_bytes=2 << 30) -> float:
+    """Fraction of edges that would land on dense MXU tiles under the
+    given cluster order — the decision statistic for --spmm auto. One
+    O(E) histogram pass over exactly _build_tiles' selection rule; no
+    tile stacks or residual tables are materialized."""
+    if len(rows) == 0:
+        return 0.0
+    n_cb = (n_src + TC - 1) // TC
+    tile_id = (perm_rows[rows] // TR).astype(np.int64) * n_cb \
+        + perm_cols[cols] // TC
+    _, _, counts, dense_sel = _select_dense(tile_id, occupancy_min,
+                                            tile_budget_bytes,
+                                            need_inverse=False)
+    return float(counts[dense_sel].sum()) / float(len(rows))
+
+
 def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
                  occupancy_min, tile_budget_bytes=2 << 30):
     """Dense tiles over cluster-ordered (rows x cols); fully vectorized.
@@ -75,17 +116,8 @@ def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
     pr = perm_rows[rows]
     pc = perm_cols[cols]
     tile_id = (pr // TR).astype(np.int64) * n_cb + pc // TC
-    uniq, inv, counts = np.unique(tile_id, return_inverse=True,
-                                  return_counts=True)
-    max_tiles = max(int(tile_budget_bytes // (TR * TC)), 1)
-    dense_sel = counts >= occupancy_min
-    if int(dense_sel.sum()) > max_tiles:
-        # keep every tile strictly above the cut, trim only among ties
-        thresh = np.sort(counts[dense_sel])[-max_tiles]
-        above = counts > thresh
-        ties = np.nonzero(dense_sel & (counts == thresh))[0]
-        dense_sel = above
-        dense_sel[ties[:max_tiles - int(above.sum())]] = True
+    uniq, inv, counts, dense_sel = _select_dense(tile_id, occupancy_min,
+                                                 tile_budget_bytes)
     B = int(dense_sel.sum())
     if B == 0:
         return (np.zeros((0, TR, TC), np.int8), np.zeros(0, np.int32),

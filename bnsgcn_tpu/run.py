@@ -145,12 +145,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         else:
             art = prepare_partition(cfg, train_g)
     cfg = cfg.replace(n_feat=art.n_feat, n_class=art.n_class, n_train=art.n_train)
-    if (multi_host and cfg.spmm == "ell" and art.ell_geometry is None):
+    if (multi_host and cfg.spmm in ("ell", "auto")
+            and art.ell_geometry is None):
         # pre-v2 artifacts lack the global ELL geometry a partial load needs
         # (hybrid gcn/graphsage is exempt: its shapes agree via a host-side
-        # allgather, no meta.json geometry required; GAT on hybrid still
-        # needs gat_fwd geometry and falls back to segment attention inside
-        # the trainer)
+        # allgather, no meta.json geometry required — but 'auto' may resolve
+        # to ell, which would build per-host tables of different shapes, so
+        # it falls back too; GAT on hybrid still needs gat_fwd geometry and
+        # falls back to segment attention inside the trainer)
         log("multi-host: artifacts carry no ELL geometry (old format); "
             "falling back to --spmm segment")
         cfg = cfg.replace(spmm="segment")

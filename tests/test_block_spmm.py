@@ -256,3 +256,59 @@ def test_chunked_dense_path_matches_oracle(dense_dtype, chunked, monkeypatch):
     d_tol = tol if dense_dtype == "native" else \
         dict(atol=0.05 * np.abs(d_ref).max())
     np.testing.assert_allclose(d_h, d_ref, **d_tol)
+
+
+def test_estimate_coverage_matches_build():
+    """The --spmm auto estimator equals the dense-edge fraction the real
+    layout build produces (same _select_dense rule, no materialization)."""
+    from bnsgcn_tpu.ops.block_spmm import estimate_coverage
+    g = sbm_graph(n_nodes=300, n_class=5, n_feat=6, p_in=0.15,
+                  p_out=0.003, seed=61)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=3))
+    for occ in (4, 64, 10**9):
+        fwd, bwd, ell_pair, arrays = _hybrid_for(art, occ)
+        for p in range(art.n_parts):
+            pi, pe = cluster_order(art.src[p], art.dst[p], art.pad_inner,
+                                   art.n_ext, target=64)
+            real = art.dst[p] < art.pad_inner
+            d, s = art.dst[p][real], art.src[p][real]
+            est = estimate_coverage(pi, pe, art.pad_inner, art.n_ext, d, s,
+                                    occupancy_min=occ)
+            frac = dense_edge_count(arrays, p) / max(len(d), 1)
+            assert abs(est - frac) < 1e-9, (occ, p, est, frac)
+
+
+def test_spmm_auto_resolution():
+    """cfg.spmm='auto' picks hybrid on a clustered graph at low occupancy
+    and ell when no tile can reach occupancy; both train."""
+    from bnsgcn_tpu.config import Config
+    from bnsgcn_tpu.models.gnn import ModelSpec, init_params
+    from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+    from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns,
+                                    init_training, place_blocks,
+                                    place_replicated)
+    g = sbm_graph(n_nodes=300, n_class=5, n_feat=6, p_in=0.15,
+                  p_out=0.003, seed=61)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=3))
+    mesh = make_parts_mesh(2)
+    for occ, expect_dense in ((4, True), (10**9, False)):
+        cfg = Config(model="graphsage", n_layers=2, n_hidden=8, spmm="auto",
+                     block_occupancy=occ, sampling_rate=1.0,
+                     n_feat=art.n_feat, n_class=art.n_class,
+                     n_train=art.n_train)
+        spec = ModelSpec("graphsage", (art.n_feat, 8, art.n_class),
+                         train_size=art.n_train)
+        fns, hspec, tables, _ = build_step_fns(cfg, spec, art, mesh)
+        has_tiles = any("tiles" in k for k in fns.extra_blk)
+        assert has_tiles == expect_dense, (occ, sorted(fns.extra_blk))
+        blk_np = build_block_arrays(art, spec.model)
+        blk_np.update(fns.extra_blk)
+        for k in fns.drop_blk_keys:
+            blk_np.pop(k, None)
+        blk = place_blocks(blk_np, mesh)
+        params, state, opt = init_training(cfg, spec, mesh)
+        params, state, opt, loss = fns.train_step(
+            params, state, opt, jnp.uint32(0), blk,
+            place_replicated(tables, mesh),
+            jax.random.key(0), jax.random.key(1))
+        assert np.isfinite(float(loss))
