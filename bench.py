@@ -349,6 +349,12 @@ def main():
                          "mid-compile; measurement sessions run it last, "
                          "separately)")
     ap.add_argument("--cache-dir", type=str, default="./bench_cache")
+    ap.add_argument("--profile-dir", type=str, default="",
+                    help="diagnostic: write a jax.profiler trace of each "
+                         "measured candidate's first epoch chunk to "
+                         "<dir>/<candidate>/ (parse with tools/trace_comm.py "
+                         "--parse --breakdown). Traced timings are never "
+                         "recorded to best_known.json")
     ap.add_argument("--json-only", action="store_true")
     ap.add_argument("--prep-only", action="store_true",
                     help="build + disk-cache artifacts and SpMM layouts, "
@@ -542,26 +548,40 @@ def main():
         hbm = estimate_static_hbm([blk], [params, opt, state])
         return fns, blk, tables_d, params, state, opt, loss, hbm
 
-    def measure(built):
+    def measure(built, name="run"):
         """Timed epochs; chains CHUNK epochs between host syncs so the
         ~50-80ms tunnel round-trip amortizes out (matches the reference's
-        free-running epoch loop)."""
+        free-running epoch loop). Under --profile-dir the FIRST chunk is
+        traced (device-lane op breakdown); its timing includes profiler
+        overhead, which is why traced runs never update best_known."""
         fns, blk, tables_d, params, state, opt, loss, _ = built
         CHUNK = 4
         total_t, min_t = 0.0, float("inf")
         e = 1
-        while e <= args.epochs:
-            n = min(CHUNK, args.epochs - e + 1)
-            t0 = time.perf_counter()
-            for _ in range(n):
-                params, state, opt, loss = fns.train_step(
-                    params, state, opt, jnp.uint32(e), blk, tables_d,
-                    skey, dkey)
-                e += 1
-            _ = float(loss)   # force device sync through the host read
-            dt = time.perf_counter() - t0
-            total_t += dt
-            min_t = min(min_t, dt / n)
+        tracing = False
+        if args.profile_dir:
+            jax.profiler.start_trace(os.path.join(
+                args.profile_dir, name.replace("+", "_")))
+            tracing = True
+        try:
+            while e <= args.epochs:
+                n = min(CHUNK, args.epochs - e + 1)
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    params, state, opt, loss = fns.train_step(
+                        params, state, opt, jnp.uint32(e), blk, tables_d,
+                        skey, dkey)
+                    e += 1
+                _ = float(loss)   # force device sync through the host read
+                if tracing:
+                    jax.profiler.stop_trace()
+                    tracing = False
+                dt = time.perf_counter() - t0
+                total_t += dt
+                min_t = min(min_t, dt / n)
+        finally:
+            if tracing:           # exception mid-measure: never leak the
+                jax.profiler.stop_trace()   # trace into the next candidate
         return total_t / args.epochs, min_t, loss
 
     best, ref_loss, ref_final = None, None, None
@@ -637,7 +657,7 @@ def main():
                 log(f"  spmm={name} step-0 loss {l0:.4f} != {gsrc} "
                     f"{gate0:.4f} (tol {tol0:.0%}); DISCARDED")
                 continue
-            et, mt, loss = measure(built)
+            et, mt, loss = measure(built, name)
         except Exception as ex:       # pragma: no cover - fallback path
             log(f"  spmm={name} failed ({type(ex).__name__}: {ex}); "
                 f"falling back")
@@ -670,13 +690,15 @@ def main():
             # advisor found this was promised but never written). TPU only —
             # a BNSGCN_BENCH_ALLOW_CPU smoke run must never masquerade as
             # carried-forward hardware data
-            if jax.default_backend() == "tpu":
+            if jax.default_backend() == "tpu" and not args.profile_dir:
                 _record_best(args, et, name)
             # provisional line: if an outer timeout kills the process before
             # all candidates run, the LAST printed JSON is still a valid
             # best-so-far result (the driver parses from the tail)
             print(json.dumps({
                 "metric": "reddit_rank_share_epoch_time_per_chip",
+                **({"status": "profiled-diagnostic"} if args.profile_dir
+                   else {}),
                 "value": round(et, 4), "unit": "s/epoch",
                 "vs_baseline": round(BASELINE_EPOCH_S / et, 3),
             }), flush=True)
@@ -692,6 +714,9 @@ def main():
 
     print(json.dumps({
         "metric": "reddit_rank_share_epoch_time_per_chip",
+        # a traced run's first chunk pays profiler overhead: tag it so the
+        # driver never records it as a clean hardware measurement
+        **({"status": "profiled-diagnostic"} if args.profile_dir else {}),
         "value": round(epoch_t, 4),
         "unit": "s/epoch",
         "vs_baseline": round(BASELINE_EPOCH_S / epoch_t, 3),
