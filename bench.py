@@ -435,6 +435,7 @@ def main():
     # before graph generation + artifact build, so a --candidates typo
     # exits in seconds instead of burning minutes of cold prep first.
     universe = [("hybrid", False, "native", "native"),
+                ("hybrid", False, "native", "int8"),
                 ("hybrid", False, "int8", "int8"),
                 ("hybrid", False, "fp8", "int8"),
                 ("hybrid", False, "fp8", "native"),

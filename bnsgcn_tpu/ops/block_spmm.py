@@ -274,7 +274,8 @@ def build_x_slabs(spec: BlockSpec, perm_src, h):
 
 
 def _tile_chunk_for(n_blocks: int, row_tile: int, width: int,
-                    budget_bytes: int = 768 << 20) -> int:
+                    budget_bytes: int = 768 << 20,
+                    col_tile: int = 0) -> int:
     """Tiles per scan chunk so the f32 per-tile partial product stays under
     `budget_bytes`. Without chunking, [B, TR, H] f32 partials at bench scale
     (B=8192, H=602 in the use_pp precompute) are 9.5 GB of HLO temp — over
@@ -285,6 +286,11 @@ def _tile_chunk_for(n_blocks: int, row_tile: int, width: int,
     width-602 precompute near 2 GB of live temps and the H=256 train step
     at ~6 chunks (~1.4 GB of carry traffic per pass instead of ~3.8 GB)."""
     per_tile = row_tile * width * 4
+    # the int8 path (col_tile > 0) adds per-chunk quantization temps on top
+    # of the f32 partial: xc [C, TC, H] f32 + qc [C, TC, H] int8 — without
+    # this the budget understates int8 peak temps ~3x (round-4 OOM class)
+    if col_tile:
+        per_tile += col_tile * width * 5
     c = max(64, budget_bytes // per_tile)
     return int(min(n_blocks, c))
 
@@ -309,14 +315,20 @@ def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h,
     B = tiles.shape[0]
     x_perm = build_x_slabs(spec, perm_src, h)
     if dense_dtype == "int8":
-        xf = x_perm.astype(jnp.float32)
-        scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=(1, 2)) / 127.0,
-                            1e-30)                         # [n_cb]
-        q = jnp.clip(jnp.round(xf / scale[:, None, None]),
-                     -127, 127).astype(jnp.int8)
+        # per-slab scales from the input-dtype amax (bf16 values are exact
+        # in f32, so this equals the old full-f32 amax); quantization runs
+        # chunk-wise inside the scan body — the old whole-stack
+        # `x_perm.astype(f32)` copy OOM'd the v5e HBM at the width-602
+        # use_pp precompute (round-4 measured RESOURCE_EXHAUSTED)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(x_perm), axis=(1, 2)).astype(jnp.float32) / 127.0,
+            1e-30)                                         # [n_cb]
 
         def chunk_prod(tiles_c, colb_c):
-            p = jnp.einsum("brc,bch->brh", tiles_c, q[colb_c],
+            xc = x_perm[colb_c].astype(jnp.float32)
+            qc = jnp.clip(jnp.round(xc / scale[colb_c][:, None, None]),
+                          -127, 127).astype(jnp.int8)
+            p = jnp.einsum("brc,bch->brh", tiles_c, qc,
                            preferred_element_type=jnp.int32)
             return p.astype(jnp.float32) * scale[colb_c][:, None, None]
     else:
@@ -326,7 +338,9 @@ def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h,
                               preferred_element_type=jnp.float32)
 
     n_seg = spec.n_row_blocks + 1
-    C = _tile_chunk_for(B, spec.row_tile, H)
+    C = _tile_chunk_for(B, spec.row_tile, H,
+                        col_tile=(spec.col_tile
+                                  if dense_dtype == "int8" else 0))
     n_full = B // C                       # >= 1: C = min(B, ...) above
     rem = B - n_full * C
 

@@ -192,6 +192,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     # tile-stack and residual-table shapes via a host-side allgather so every
     # process compiles the identical program from its local parts.
     ell_spmm, ell_keys, ell_arrays = None, (), {}
+    ell_spmm_pre = None
     spmm_kind = cfg.spmm
     auto_perms = None
     if spmm_kind == "auto":
@@ -279,6 +280,16 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                                    use_pallas=cfg.use_pallas,
                                    gather_dtype=cfg.spmm_gather,
                                    dense_dtype=cfg.spmm_dense)
+        # the one-time use_pp precompute always aggregates with NATIVE
+        # codecs: quantized gathers/tiles are per-epoch throughput knobs,
+        # and the int8 dense path's extra per-chunk intermediates OOM the
+        # v5e HBM at the raw-feature width (602) the precompute runs at
+        # (round-4 measured RESOURCE_EXHAUSTED; H=256 train steps fit)
+        if cfg.spmm_gather != "native" or cfg.spmm_dense != "native":
+            ell_spmm_pre = make_block_spmm(fwd_b, bwd_b, ell_pair,
+                                           use_pallas=cfg.use_pallas)
+        else:
+            ell_spmm_pre = ell_spmm
         ell_keys = tuple(ell_arrays.keys())
     elif spmm_kind == "ell" and spec.model in ("gcn", "graphsage"):
         from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
@@ -295,6 +306,13 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                                  len(fwd_spec.widths), len(bwd_spec.widths),
                                  use_pallas=cfg.use_pallas,
                                  gather_dtype=cfg.spmm_gather)
+        if cfg.spmm_gather != "native":
+            ell_spmm_pre = make_ell_spmm(fwd_spec, bwd_spec,
+                                         len(fwd_spec.widths),
+                                         len(bwd_spec.widths),
+                                         use_pallas=cfg.use_pallas)
+        else:
+            ell_spmm_pre = ell_spmm
         ell_keys = tuple(ell_arrays.keys())
 
     # dense per-row GAT attention over an (uncapped) ELL layout; geometry
@@ -315,11 +333,18 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
               f"model={spec.model!r} (only the ell/hybrid GCN/GraphSAGE "
               f"aggregation paths quantize gathers)", file=sys.stderr)
 
-    def _aggregate_for(blk):
-        if ell_spmm is None:
+    def _agg_for(spmm, blk):
+        if spmm is None:
             return None
         arrays = {k: blk[k] for k in ell_keys}
-        return lambda h_ext: ell_spmm(arrays, h_ext)
+        return lambda h_ext: spmm(arrays, h_ext)
+
+    def _aggregate_for(blk):
+        return _agg_for(ell_spmm, blk)
+
+    def _aggregate_pre_for(blk):
+        """Native-codec aggregation for the one-time precompute."""
+        return _agg_for(ell_spmm_pre, blk)
 
     def _gat_ell_for(blk):
         if gat_spec is None:
@@ -407,7 +432,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     def local_precompute(blk, tables_full):
         blk = {k: v[0] for k, v in blk.items()}
-        agg = _aggregate_for(blk) or (lambda h: agg_sum(
+        agg = _aggregate_pre_for(blk) or (lambda h: agg_sum(
             h, blk["src"], blk["dst"], hspec.pad_inner, cfg.edge_chunk))
         feat_ext = precompute_exchange(hspec_full, tables_full, blk["bnd"], blk["feat"])
         if spec.model == "gcn":

@@ -143,18 +143,35 @@ def program_cost(bucket, cat="exchange"):
     return raw, min_est, n, len(lanes)
 
 
+NON_OP_LANES = ("python", "Steps", "XLA Modules", "TC Overlay")
+
+
 def breakdown(events, top=25):
+    """Device time by HLO category (TPU traces carry args.hlo_category)
+    and by op name — the profiler view that guides kernel work."""
     tnames = _thread_names(events)
-    op_us = {}
+    op_us, cat_us = {}, {}
     for ev in events:
         if ev.get("ph") != "X":
             continue
         lane = tnames.get((ev["pid"], ev["tid"]), "")
-        if lane == "python":
+        # keep op-level lanes only: 'XLA Ops'/'Async XLA Ops' on TPU,
+        # 'tf_XLAEigen/...' executor lanes on CPU — never the step/module
+        # marker lanes, whose spans cover whole epochs
+        if lane in NON_OP_LANES:
             continue
-        base = re.sub(r"[.\d]+$", "", ev.get("name", ""))
-        op_us[base] = op_us.get(base, 0.0) + float(ev.get("dur", 0.0))
+        dur = float(ev.get("dur", 0.0))
+        base = re.sub(r"[.\d]+$", "", ev.get("name", "")) or ev.get("name", "")
+        op_us[base] = op_us.get(base, 0.0) + dur
+        cat = (ev.get("args") or {}).get("hlo_category")
+        if cat:
+            cat_us[cat] = cat_us.get(cat, 0.0) + dur
     tot = sum(op_us.values()) or 1.0
+    if cat_us:
+        print(f"\ndevice time by HLO category "
+              f"({sum(cat_us.values())/1e6:.3f} s categorized):")
+        for name, us in sorted(cat_us.items(), key=lambda kv: -kv[1]):
+            print(f"  {us/1e6:9.4f} s  {us/tot*100:5.1f}%  {name}")
     print(f"\ntop device ops by time ({tot/1e6:.3f} s total):")
     for name, us in sorted(op_us.items(), key=lambda kv: -kv[1])[:top]:
         print(f"  {us/1e6:9.4f} s  {us/tot*100:5.1f}%  {name}")
