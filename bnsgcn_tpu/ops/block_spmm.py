@@ -378,10 +378,11 @@ def _dense_apply(spec: BlockSpec, tiles, rowb, colb, perm_src, perm_out, h,
 
 def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
                     use_pallas: bool = False, gather_dtype: str = "native",
-                    dense_dtype: str = "native"):
+                    dense_dtype: str = "native", accum: str = "auto"):
     """Returns spmm(arrays, h_ext) -> [n_dst, H]: dense tiles on the MXU +
     ELL residual, custom VJP running the transposed tiles.
-    dense_dtype='int8': quantized int8 MXU tile path (see _dense_apply)."""
+    dense_dtype='int8': quantized int8 MXU tile path (see _dense_apply).
+    accum: residual-ELL accumulation strategy (ops/ell._bucket_sum)."""
     if use_pallas and dense_dtype != "native":
         import sys
         print(f"block_spmm: use_pallas takes the fused Pallas dense path on "
@@ -390,13 +391,13 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
     ell_fwd, ell_bwd = ell_pair
     ell = make_ell_spmm(ell_fwd, ell_bwd, len(ell_fwd.widths),
                         len(ell_bwd.widths), use_pallas=use_pallas,
-                        gather_dtype=gather_dtype)
+                        gather_dtype=gather_dtype, accum=accum)
     # transposed residual operator for the backward: same tables with the
     # fwd/bwd roles swapped (a nested vjp at a dummy point would record an
     # unvarying primal and trip shard_map's varying-axes check)
     ell_t = make_ell_spmm(ell_bwd, ell_fwd, len(ell_bwd.widths),
                           len(ell_fwd.widths), use_pallas=use_pallas,
-                          gather_dtype=gather_dtype)
+                          gather_dtype=gather_dtype, accum=accum)
 
     def _res_arrays(arrays):
         return {k[len("res_"):]: v for k, v in arrays.items()

@@ -292,14 +292,65 @@ def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
 
 
 def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
-                use_pallas: bool = False):
-    """sum over ELL width for one bucket, row-chunked so the gathered
-    [rows, w, H] intermediate never exceeds ~chunk_gathers * H elements.
+                use_pallas: bool = False, accum: str = "auto"):
+    """sum over ELL width for one bucket.
+
+    accum='unroll' (the TPU default for native-dtype rows): per-column
+    accumulation `acc += hp[idx[:, j]]` in 16-column unrolled f32 chains,
+    scanned over column blocks for w > 16 — no [rows, w, H] gathered
+    intermediate is ever materialized, so the bucket runs near the gather
+    unit's row rate instead of paying an extra HBM round-trip.
+    v5e-measured on the bench cap bucket ([150k, 128] idx, H=256):
+    block-scan 81.5 ms (16-col) / 79.4 ms (32-col) vs 154.4 ms for the
+    chunked reduce — 1.9x; a fully-unrolled 128-chain also wins (90.5 ms)
+    but blows the remote compiler up at full train-step scale, and pure
+    fori/scan per column loses it all to carry re-traffic (145.7 ms).
+    f32 chains also accumulate more precisely than the bf16 tree reduce.
+
+    accum='reduce': the materialize-then-sum path, row-chunked so the
+    gathered intermediate never exceeds ~chunk_gathers * H elements; it
+    serves the quantized gather modes (their convert must happen on the
+    gathered block), non-TPU backends (unrolled gathers lower poorly
+    there), and use_pallas='bucket-reduce' experiments.
 
     use_pallas routes the width reduction through the standard-pipeline
     Pallas kernel (ops/pallas_spmm.pallas_bucket_reduce)."""
+    if accum not in ("auto", "unroll", "reduce"):
+        raise ValueError(f"unknown accum mode {accum!r}")
     r = idx.shape[0]
     h_dim = hp.shape[1]
+    native = hp.dtype not in (jnp.float8_e4m3fn, jnp.int8)
+    if accum == "auto":
+        # unroll beats BOTH the jnp chunked reduce and pallas_bucket_reduce
+        # (which only fuses the reduction, not the gather materialization),
+        # so use_pallas does not disable it — pass accum='reduce' explicitly
+        # to study the materializing paths
+        accum = ("unroll" if native and jax.default_backend() == "tpu"
+                 else "reduce")
+    BS = 16
+    if accum == "unroll" and not native:
+        # the quantized gather modes must convert on the gathered block
+        raise ValueError("accum='unroll' requires a native-dtype hp; "
+                         "quantized gathers take accum='reduce'")
+    if (accum == "unroll" and r > 0 and w > 1
+            and (w <= BS or w % BS == 0)):
+        def chain(cb, n):
+            a = hp[cb[0]].astype(jnp.float32)
+            for j in range(1, n):
+                a = a + hp[cb[j]].astype(jnp.float32)
+            return a
+
+        if w <= BS:
+            return chain(idx.T, w).astype(hp.dtype)
+        cols = idx.T.reshape(w // BS, BS, r)
+        # derive the init from the input so the carry has the same varying
+        # manual axes as the body output under shard_map (same contract as
+        # block_spmm._dense_apply's acc0); the empty slice reads no data
+        acc0 = jnp.zeros((r, h_dim), jnp.float32) \
+            + jnp.sum(hp[:0]).astype(jnp.float32)
+        out, _ = jax.lax.scan(lambda acc, cb: (acc + chain(cb, BS), None),
+                              acc0, cols)
+        return out.astype(hp.dtype)
     rows_per_chunk = max(1, chunk_gathers // max(w, 1))
     # Pallas path: on-TPU only (off-TPU falls back to the jnp reduce — Mosaic
     # doesn't lower there and the interpreter doesn't compose with shard_map's
@@ -359,7 +410,8 @@ def ell_combine(spec: EllSpec, outs, perm, chunk_pos=None, chunk_seg=None):
 
 
 def _ell_apply(spec: EllSpec, idx_list, perm, h, use_pallas: bool = False,
-               chunk_pos=None, chunk_seg=None, gather_dtype: str = "native"):
+               chunk_pos=None, chunk_seg=None, gather_dtype: str = "native",
+               accum: str = "auto"):
     """Bucketed gather+sum (+ split-row combine), then one permutation gather.
     The only scatter is the tiny sorted segment-sum over split-row chunks.
 
@@ -387,7 +439,8 @@ def _ell_apply(spec: EllSpec, idx_list, perm, h, use_pallas: bool = False,
         hp = jnp.concatenate([h, jnp.zeros((1, h.shape[1]), h.dtype)], 0)
     outs = []
     for k, w in enumerate(spec.widths):
-        outs.append(_bucket_sum(hp, idx_list[k], w, use_pallas=use_pallas))
+        outs.append(_bucket_sum(hp, idx_list[k], w, use_pallas=use_pallas,
+                                accum=accum))
     out = ell_combine(spec, outs, perm, chunk_pos, chunk_seg)
     if scale is not None:
         out = (out.astype(jnp.float32) * scale).astype(h.dtype)
@@ -396,7 +449,7 @@ def _ell_apply(spec: EllSpec, idx_list, perm, h, use_pallas: bool = False,
 
 def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
                   n_buckets_bwd: int, use_pallas: bool = False,
-                  gather_dtype: str = "native"):
+                  gather_dtype: str = "native", accum: str = "auto"):
     """Returns spmm(arrays, h_ext) -> [n_dst, H] with a custom VJP that runs
     the transposed layout (also scatter-free) on the backward pass. The
     backward quantizes the cotangent with its OWN fp8 scale when
@@ -407,7 +460,7 @@ def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
         idx = [arrays[f"fwd_idx_{k}"] for k in range(n_buckets_fwd)]
         return _ell_apply(fwd_spec, idx, arrays["fwd_perm"], h_ext, use_pallas,
                           arrays.get("fwd_chunk_pos"), arrays.get("fwd_chunk_seg"),
-                          gather_dtype=gather_dtype)
+                          gather_dtype=gather_dtype, accum=accum)
 
     def fwd(arrays, h_ext):
         return spmm(arrays, h_ext), (arrays,)
@@ -417,7 +470,7 @@ def make_ell_spmm(fwd_spec: EllSpec, bwd_spec: EllSpec, n_buckets_fwd: int,
         idx = [arrays[f"bwd_idx_{k}"] for k in range(n_buckets_bwd)]
         d_h = _ell_apply(bwd_spec, idx, arrays["bwd_perm"], g, use_pallas,
                          arrays.get("bwd_chunk_pos"), arrays.get("bwd_chunk_seg"),
-                         gather_dtype=gather_dtype)
+                         gather_dtype=gather_dtype, accum=accum)
         return None, d_h
 
     spmm.defvjp(fwd, bwd)

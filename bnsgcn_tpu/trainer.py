@@ -285,11 +285,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         # and the int8 dense path's extra per-chunk intermediates OOM the
         # v5e HBM at the raw-feature width (602) the precompute runs at
         # (round-4 measured RESOURCE_EXHAUSTED; H=256 train steps fit)
-        if cfg.spmm_gather != "native" or cfg.spmm_dense != "native":
-            ell_spmm_pre = make_block_spmm(fwd_b, bwd_b, ell_pair,
-                                           use_pallas=cfg.use_pallas)
-        else:
-            ell_spmm_pre = ell_spmm
+        ell_spmm_pre = make_block_spmm(fwd_b, bwd_b, ell_pair,
+                                       use_pallas=cfg.use_pallas,
+                                       accum="reduce")
         ell_keys = tuple(ell_arrays.keys())
     elif spmm_kind == "ell" and spec.model in ("gcn", "graphsage"):
         from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
@@ -306,13 +304,11 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                                  len(fwd_spec.widths), len(bwd_spec.widths),
                                  use_pallas=cfg.use_pallas,
                                  gather_dtype=cfg.spmm_gather)
-        if cfg.spmm_gather != "native":
-            ell_spmm_pre = make_ell_spmm(fwd_spec, bwd_spec,
-                                         len(fwd_spec.widths),
-                                         len(bwd_spec.widths),
-                                         use_pallas=cfg.use_pallas)
-        else:
-            ell_spmm_pre = ell_spmm
+        ell_spmm_pre = make_ell_spmm(fwd_spec, bwd_spec,
+                                     len(fwd_spec.widths),
+                                     len(bwd_spec.widths),
+                                     use_pallas=cfg.use_pallas,
+                                     accum="reduce")
         ell_keys = tuple(ell_arrays.keys())
 
     # dense per-row GAT attention over an (uncapped) ELL layout; geometry

@@ -161,3 +161,19 @@ def test_quantized_gather_close_to_native(qmode):
     assert not np.allclose(outs[qmode], outs["native"])   # really quantized
     gscale = np.abs(grads["native"]).max() + 1e-9
     assert np.abs(grads[qmode] - grads["native"]).max() / gscale < 0.05
+
+
+def test_bucket_sum_unroll_matches_reduce():
+    """The TPU-default unrolled f32-chain accumulation equals the
+    materialize-then-reduce path (f32 chains vs bf16 tree: compare in the
+    reduce path's own precision envelope)."""
+    import jax.numpy as jnp
+    from bnsgcn_tpu.ops.ell import _bucket_sum
+    rng = np.random.default_rng(5)
+    # 16 = largest single unrolled chain, 32 = smallest 2-block scan
+    for w in (2, 4, 8, 16, 32, 128):
+        hp = jnp.asarray(rng.normal(size=(500, 16)), jnp.float32)
+        idx = jnp.asarray(rng.integers(0, 500, size=(37, w)).astype(np.int32))
+        a = np.asarray(_bucket_sum(hp, idx, w, accum="unroll"))
+        b = np.asarray(_bucket_sum(hp, idx, w, accum="reduce"))
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
