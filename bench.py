@@ -339,8 +339,10 @@ def main():
                     help="dcsbm: Reddit-calibrated clustered stand-in "
                          "(default); uniform: structure-free worst case")
     ap.add_argument("--spmm", choices=["hybrid", "ell"], default="hybrid")
-    ap.add_argument("--occupancy", type=int, default=512,
-                    help="hybrid: min edges per 512x512 tile to densify")
+    ap.add_argument("--occupancy", type=int, default=0,
+                    help="hybrid: min edges per tile to densify "
+                         "(0 = auto: the tile's byte break-even, "
+                         "tile*tile/512 — 512 for 512x512, 128 for +t256)")
     ap.add_argument("--tile-budget-mb", type=int, default=2048,
                     help="hybrid: int8 dense-tile HBM budget per direction")
     ap.add_argument("--no-pallas", action="store_true",
@@ -434,27 +436,38 @@ def main():
     # from the full documented name set. Candidate validation runs HERE,
     # before graph generation + artifact build, so a --candidates typo
     # exits in seconds instead of burning minutes of cold prep first.
-    universe = [("hybrid", False, "native", "native"),
-                ("hybrid", False, "native", "int8"),
-                ("hybrid", False, "int8", "int8"),
-                ("hybrid", False, "fp8", "int8"),
-                ("hybrid", False, "fp8", "native"),
-                ("ell", False, "int8", "native"),
-                ("ell", False, "fp8", "native")]
-    if jax.default_backend() == "tpu" and not args.no_pallas:
-        universe.append(("hybrid", True, "native", "native"))
-        # fused Pallas dense tiles + native-convert 1-byte residual gathers
-        universe.append(("hybrid", True, "int8", "native"))
-    anchor = ("ell", False, "native", "native")
+    # variant = (spmm, use_pallas, gather_dtype, dense_dtype, tile).
+    # MEASURED WINNERS FIRST (v5e 2026-07-30: hybrid+pallas 0.573 s/epoch,
+    # hybrid 0.87, ell 1.67, i8g/f8g reduce-path variants lose) so a
+    # budget-starved window still measures the best known before exploring.
+    pallas_ok = jax.default_backend() == "tpu" and not args.no_pallas
+    universe = []
+    if pallas_ok:
+        universe += [("hybrid", True, "native", "native", 512),
+                     # finer tiles: 4x tiles/budget-byte, less ELL residual
+                     ("hybrid", True, "native", "native", 256),
+                     # fused Pallas dense + 1-byte int8-unroll residual rows
+                     ("hybrid", True, "int8", "native", 512),
+                     ("hybrid", True, "int8", "native", 256)]
+    universe += [("hybrid", False, "native", "native", 512),
+                 ("hybrid", False, "native", "native", 256),
+                 ("hybrid", False, "native", "int8", 512),
+                 ("hybrid", False, "int8", "int8", 512),
+                 ("hybrid", False, "fp8", "int8", 512),
+                 ("hybrid", False, "fp8", "native", 512),
+                 ("ell", False, "int8", "native", 512),
+                 ("ell", False, "fp8", "native", 512)]
+    anchor = ("ell", False, "native", "native", 512)
     if args.spmm == "hybrid":
         candidates = [anchor] + universe
     else:
-        candidates = [(args.spmm, False, "native", "native")]
+        candidates = [(args.spmm, False, "native", "native", 512)]
 
     def _vname(v):
         return (v[0] + ("+pallas" if v[1] else "")
                 + ({"fp8": "+f8g", "int8": "+i8g"}.get(v[2], ""))
-                + ("+i8d" if v[3] == "int8" else ""))
+                + ("+i8d" if v[3] == "int8" else "")
+                + (f"+t{v[4]}" if v[4] != 512 else ""))
 
     if args.candidates:
         by_name = {_vname(v): v for v in universe}
@@ -501,7 +514,7 @@ def main():
     skey, dkey = jax.random.key(0), jax.random.key(1)
 
     def make_cfg(variant):
-        spmm, use_pallas, gather, dense = variant
+        spmm, use_pallas, gather, dense, tile = variant
         return Config(model="graphsage", n_layers=args.layers,
                       n_hidden=args.hidden, use_pp=True, dropout=0.5,
                       lr=0.01, sampling_rate=0.1, spmm=spmm,
@@ -509,6 +522,7 @@ def main():
                       spmm_dense=dense,
                       block_occupancy=args.occupancy,
                       block_tile_budget_mb=args.tile_budget_mb,
+                      block_tile=tile,
                       n_feat=art.n_feat, n_class=art.n_class,
                       n_train=art.n_train)
 
@@ -591,41 +605,51 @@ def main():
     # tighter than the old blanket 10%-vs-ell gate, which was wide enough
     # to let a miscompiled int8 kernel win the headline (round-2 advisor)
     native_l0, native_lf = {}, {}
-    # share built layouts across candidates AND across runs (disk): key set
-    # must match trainer.build_step_fns ('ell', f'hybrid:{occ}:{budget}').
-    # The ell layouts don't depend on the hybrid tuning knobs, so they get
-    # their own file and survive occupancy/budget sweeps.
+    # share built layouts across candidates AND across runs (disk): keys
+    # come from trainer.hybrid_layout_key so they cannot drift. The ell
+    # layouts don't depend on the hybrid tuning knobs, so they get their
+    # own file and survive occupancy/budget/tile sweeps; each hybrid
+    # tiling geometry gets its own file (multi-GB stacks — one file per
+    # key avoids rewriting every stack when one is added).
+    from bnsgcn_tpu.trainer import hybrid_layout_key, hybrid_tiling
+
+    def variant_key(variant):
+        return ("ell" if variant[0] != "hybrid"
+                else hybrid_layout_key(make_cfg(variant)))
+
+    def hyb_path_for(variant):
+        occ, tile, budget = hybrid_tiling(make_cfg(variant))
+        suf = f"_t{tile}" if tile != 512 else ""
+        return os.path.join(
+            args.cache_dir, f"layouts_hyb_{tag}_{occ}_{budget}{suf}.pkl")
+
+    hyb_variants = {variant_key(v): v for v in candidates
+                    if v[0] == "hybrid"}
     ell_path = os.path.join(args.cache_dir, f"layouts_ell_{tag}.pkl")
-    hyb_path = os.path.join(
-        args.cache_dir,
-        f"layouts_hyb_{tag}_{args.occupancy}_{args.tile_budget_mb}.pkl")
     layout_cache = _try_load(ell_path, log) or {}
-    layout_cache.update(_try_load(hyb_path, log) or {})
+    for v in hyb_variants.values():
+        layout_cache.update(_try_load(hyb_path_for(v), log) or {})
     if layout_cache:
         log(f"  layout cache: {sorted(layout_cache)}")
     lc_keys0 = set(layout_cache)
 
     def persist_layouts():
         nonlocal lc_keys0
-        if set(layout_cache) == lc_keys0:
-            return
-        for path, keys in ((ell_path, {"ell"}),
-                           (hyb_path, set(layout_cache) - {"ell"})):
-            sub = {k: layout_cache[k] for k in keys if k in layout_cache}
-            if sub and not (set(sub) <= lc_keys0):
-                _atomic_dump(sub, path)
+        for key in set(layout_cache) - lc_keys0:
+            path = (ell_path if key == "ell"
+                    else hyb_path_for(hyb_variants[key]))
+            _atomic_dump({key: layout_cache[key]}, path)
         lc_keys0 = set(layout_cache)
     if args.prep_only:
         for variant in candidates:
-            key = ("ell" if variant[0] == "ell" else
-                   f"hybrid:{args.occupancy}:{args.tile_budget_mb}")
+            key = variant_key(variant)
             if variant[1] or key in layout_cache:   # pallas + fp8 twins
                 continue                            # share the same layouts
             t0 = time.time()
             build_step_fns(make_cfg(variant), spec, art, mesh,
                            layout_cache=layout_cache)
             persist_layouts()
-            log(f"  prep {variant[0]}: {time.time() - t0:.1f}s")
+            log(f"  prep {_vname(variant)}: {time.time() - t0:.1f}s")
         log(f"prep-only done: {sorted(layout_cache)}")
         return
 

@@ -75,11 +75,18 @@ class Config:
     spmm_dense: str = "native"          # hybrid SpMM dense-tile matmul dtype: 'native'
                                         # (compute dtype) | 'int8' (quantized slabs,
                                         # int8x int8 MXU at ~2x bf16 rate)
-    block_occupancy: int = 512          # hybrid SpMM: min edges for a 512x512 tile to
-                                        # densify (byte break-even ~512; MXU-time
-                                        # break-even nearer ~1200 at 31 TFLOP/s)
+    block_occupancy: int = 0            # hybrid SpMM: min edges for a tile to densify.
+                                        # 0 = auto: the tile's byte break-even,
+                                        # tile*tile/512 (512 at the default 512x512
+                                        # tile, 128 at 256x256); explicit values are
+                                        # absolute (MXU-time break-even is nearer
+                                        # ~1200 at 31 TFLOP/s for 512x512)
     block_tile_budget_mb: int = 2048    # hybrid SpMM: int8 dense-tile HBM budget per
                                         # direction (8192 tiles at 512x512)
+    block_tile: int = 512               # hybrid SpMM: square tile edge (512 default;
+                                        # 256 = 4x more tiles per budget byte, finer
+                                        # edge capture on clustered graphs at ~2x the
+                                        # slab-gather traffic per tile byte)
     profile_dir: str = ""               # write a jax.profiler trace of a few epochs here
     remat: bool = False                 # rematerialize each layer in backward (saves HBM,
                                         # recomputes activations incl. the halo exchange)
@@ -177,8 +184,9 @@ def create_parser() -> argparse.ArgumentParser:
     both("use-pallas", action="store_true", default=False)
     both("spmm-gather", type=str, default="native", choices=["native", "fp8", "int8"])
     both("spmm-dense", type=str, default="native", choices=["native", "int8"])
-    both("block-occupancy", type=int, default=512)
+    both("block-occupancy", type=int, default=0)
     both("block-tile-budget-mb", type=int, default=2048)
+    both("block-tile", type=int, default=512)
     both("ckpt-path", type=str, default="./checkpoint/")
     both("results-path", type=str, default="./results/")
     p.add_argument("--resume", action="store_true")

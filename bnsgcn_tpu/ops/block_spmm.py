@@ -43,8 +43,12 @@ import numpy as np
 from bnsgcn_tpu.ops.ell import (ELL_SPLIT_CAP, GeoAccum, build_layouts,
                                 make_ell_spmm)
 
-TR = 512          # dst rows per dense tile (square: transposes keep shape,
-TC = 512          # and per-edge slab/output overhead beats narrow tiles)
+TR = 512          # default dst rows per dense tile (square: transposes keep
+TC = 512          # shape, and per-edge slab/output overhead beats narrow
+                  # tiles). Finer tiles (256) capture more edge mass per tile
+                  # byte on clustered graphs — same budget, less ELL residual
+                  # — at the cost of ~2x slab-gather traffic per tile byte;
+                  # selectable per run (config --block-tile, bench +t256).
 
 
 @dataclass(frozen=True)
@@ -58,8 +62,18 @@ class BlockSpec:
     n_row_blocks: int              # ceil(n_rows / row_tile)
 
 
+def effective_occupancy(occupancy: int, tile_r: int = TR,
+                        tile_c: int = TC) -> int:
+    """Resolve the occupancy knob: 0 = auto, the byte break-even of a
+    tile_r x tile_c int8 tile vs 512B gather rows (~tile_bytes/512 edges:
+    512 at the default 512x512 tile, 128 at 256x256). Explicit values are
+    absolute edge counts. Centralized so trainer CLI runs, bench variants,
+    and tools all scale the threshold with tile area identically."""
+    return occupancy if occupancy > 0 else max(tile_r * tile_c // 512, 16)
+
+
 def _select_dense(tile_id, occupancy_min, tile_budget_bytes,
-                  need_inverse=True):
+                  tile_bytes=TR * TC, need_inverse=True):
     """Which tiles densify: >= occupancy_min edges, highest-count tiles win
     under the HBM budget (ties trimmed last). Shared by the real layout
     build and the O(E) coverage estimator behind --spmm auto (which skips
@@ -70,7 +84,7 @@ def _select_dense(tile_id, occupancy_min, tile_budget_bytes,
     else:
         uniq, counts = np.unique(tile_id, return_counts=True)
         inv = None
-    max_tiles = max(int(tile_budget_bytes // (TR * TC)), 1)
+    max_tiles = max(int(tile_budget_bytes // tile_bytes), 1)
     dense_sel = counts >= occupancy_min
     if int(dense_sel.sum()) > max_tiles:
         # keep every tile strictly above the cut, trim only among ties
@@ -83,44 +97,50 @@ def _select_dense(tile_id, occupancy_min, tile_budget_bytes,
 
 
 def estimate_coverage(perm_rows, perm_cols, n_rows, n_src, rows, cols,
-                      occupancy_min=512, tile_budget_bytes=2 << 30) -> float:
+                      occupancy_min=512, tile_budget_bytes=2 << 30,
+                      tile_r=TR, tile_c=TC) -> float:
     """Fraction of edges that would land on dense MXU tiles under the
     given cluster order — the decision statistic for --spmm auto. One
     O(E) histogram pass over exactly _build_tiles' selection rule; no
     tile stacks or residual tables are materialized."""
     if len(rows) == 0:
         return 0.0
-    n_cb = (n_src + TC - 1) // TC
-    tile_id = (perm_rows[rows] // TR).astype(np.int64) * n_cb \
-        + perm_cols[cols] // TC
+    n_cb = (n_src + tile_c - 1) // tile_c
+    tile_id = (perm_rows[rows] // tile_r).astype(np.int64) * n_cb \
+        + perm_cols[cols] // tile_c
     _, _, counts, dense_sel = _select_dense(tile_id, occupancy_min,
                                             tile_budget_bytes,
+                                            tile_bytes=tile_r * tile_c,
                                             need_inverse=False)
     return float(counts[dense_sel].sum()) / float(len(rows))
 
 
 def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
-                 occupancy_min, tile_budget_bytes=2 << 30):
+                 occupancy_min, tile_budget_bytes=2 << 30,
+                 tile_r=TR, tile_c=TC):
     """Dense tiles over cluster-ordered (rows x cols); fully vectorized.
 
     A tile densifies only if it carries >= occupancy_min edges (an int8
     512x512 tile costs TR*TC = 256KB of HBM reads per pass plus its slab
     and output shares — byte break-even vs 512B-row gathers lands around
-    ~512 edges, the default threshold) AND the total dense storage stays
-    under tile_budget_bytes (highest-count tiles win; ties trimmed last).
-    Returns (tiles int8 [B,TR,TC] sorted by row_blk, row_blk, col_blk,
-    residual_edge_mask, extra_rows, extra_cols) — the extras are >127
-    multiplicity overflow in PERMUTED coordinates. Accumulation runs in
-    ~1 GB int32 chunks so peak host memory stays near the budget itself."""
-    n_cb = (n_src + TC - 1) // TC
+    ~512 edges, the default threshold; scale occupancy with tile area) AND
+    the total dense storage stays under tile_budget_bytes (highest-count
+    tiles win; ties trimmed last).
+    Returns (tiles int8 [B,tile_r,tile_c] sorted by row_blk, row_blk,
+    col_blk, residual_edge_mask, extra_rows, extra_cols) — the extras are
+    >127 multiplicity overflow in PERMUTED coordinates. Accumulation runs
+    in ~1 GB int32 chunks so peak host memory stays near the budget."""
+    n_cb = (n_src + tile_c - 1) // tile_c
     pr = perm_rows[rows]
     pc = perm_cols[cols]
-    tile_id = (pr // TR).astype(np.int64) * n_cb + pc // TC
+    tile_id = (pr // tile_r).astype(np.int64) * n_cb + pc // tile_c
     uniq, inv, counts, dense_sel = _select_dense(tile_id, occupancy_min,
-                                                 tile_budget_bytes)
+                                                 tile_budget_bytes,
+                                                 tile_bytes=tile_r * tile_c)
     B = int(dense_sel.sum())
     if B == 0:
-        return (np.zeros((0, TR, TC), np.int8), np.zeros(0, np.int32),
+        return (np.zeros((0, tile_r, tile_c), np.int8),
+                np.zeros(0, np.int32),
                 np.zeros(0, np.int32), np.ones(len(rows), dtype=bool),
                 np.zeros(0, np.int64), np.zeros(0, np.int64))
 
@@ -135,23 +155,23 @@ def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
 
     order2 = np.argsort(e_rank[m], kind="stable")
     er_s = e_rank[m][order2]
-    prm_s = (pr[m] % TR)[order2]
-    pcm_s = (pc[m] % TC)[order2]
-    tiles8 = np.zeros((B, TR, TC), dtype=np.int8)
+    prm_s = (pr[m] % tile_r)[order2]
+    pcm_s = (pc[m] % tile_c)[order2]
+    tiles8 = np.zeros((B, tile_r, tile_c), dtype=np.int8)
     extra_rows_l, extra_cols_l = [], []
-    chunk = max(1, (1 << 30) // (TR * TC * 4))           # ~1 GB int32
+    chunk = max(1, (1 << 30) // (tile_r * tile_c * 4))   # ~1 GB int32
     for c0 in range(0, B, chunk):
         c1 = min(c0 + chunk, B)
         lo, hi = np.searchsorted(er_s, [c0, c1])
-        t32 = np.zeros((c1 - c0, TR, TC), dtype=np.int32)
+        t32 = np.zeros((c1 - c0, tile_r, tile_c), dtype=np.int32)
         np.add.at(t32, (er_s[lo:hi] - c0, prm_s[lo:hi], pcm_s[lo:hi]), 1)
         ob, orr, occ = np.nonzero(t32 > 127)
         if len(ob):
             rep = (t32[ob, orr, occ] - 127).astype(np.int64)
             extra_rows_l.append(np.repeat(
-                orr + row_blk[ob + c0].astype(np.int64) * TR, rep))
+                orr + row_blk[ob + c0].astype(np.int64) * tile_r, rep))
             extra_cols_l.append(np.repeat(
-                occ + col_blk[ob + c0].astype(np.int64) * TC, rep))
+                occ + col_blk[ob + c0].astype(np.int64) * tile_c, rep))
             np.minimum(t32, 127, out=t32)
         tiles8[c0:c1] = t32.astype(np.int8)
     return (tiles8, row_blk, col_blk, resid_mask,
@@ -163,7 +183,8 @@ def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
 
 def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
                         perm_ext, occupancy_min=512,
-                        tile_budget_bytes=2 << 30, agree=None):
+                        tile_budget_bytes=2 << 30, agree=None,
+                        tile_r=TR, tile_c=TC):
     """Hybrid layout for all local parts. perm_inner [P, n_dst] /
     perm_ext [P, n_src_ext]: cluster position per original row (the inner
     prefix of perm_ext must equal perm_inner).
@@ -182,7 +203,7 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
         s, d = src_all[p][real], dst_all[p][real]
         tiles, rb, cb, resid, xr, xc = _build_tiles(
             perm_inner[p], perm_ext[p], n_dst, n_src_ext, d, s, occupancy_min,
-            tile_budget_bytes)
+            tile_budget_bytes, tile_r=tile_r, tile_c=tile_c)
         per_part.append((tiles, rb, cb))
         # excess-multiplicity edges come back in PERMUTED coordinates —
         # map to original ids for the residual ELL
@@ -204,12 +225,12 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
         acc_f.merge_state(merged["geo_f"])
         acc_b.merge_state(merged["geo_b"])
     res_geometry = {"fwd": acc_f.finish(), "bwd": acc_b.finish()}
-    n_rb_f = (n_dst + TR - 1) // TR
-    n_rb_b = (n_src_ext + TC - 1) // TC
-    tiles_f = np.zeros((P, B, TR, TC), dtype=np.int8)
+    n_rb_f = (n_dst + tile_r - 1) // tile_r
+    n_rb_b = (n_src_ext + tile_c - 1) // tile_c
+    tiles_f = np.zeros((P, B, tile_r, tile_c), dtype=np.int8)
     rowb_f = np.full((P, B), n_rb_f, dtype=np.int32)
     colb_f = np.zeros((P, B), dtype=np.int32)
-    tiles_b = np.zeros((P, B, TC, TR), dtype=np.int8)
+    tiles_b = np.zeros((P, B, tile_c, tile_r), dtype=np.int8)
     rowb_b = np.full((P, B), n_rb_b, dtype=np.int32)
     colb_b = np.zeros((P, B), dtype=np.int32)
     for p, (tiles, rb, cb) in enumerate(per_part):
@@ -248,10 +269,10 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
     for k, v in ell_arrays.items():
         arrays[f"res_{k}"] = v
 
-    fwd = BlockSpec(n_rows=n_dst, n_src=n_src_ext, row_tile=TR, col_tile=TC,
-                    n_blocks=B, n_row_blocks=n_rb_f)
-    bwd = BlockSpec(n_rows=n_src_ext, n_src=n_dst, row_tile=TC, col_tile=TR,
-                    n_blocks=B, n_row_blocks=n_rb_b)
+    fwd = BlockSpec(n_rows=n_dst, n_src=n_src_ext, row_tile=tile_r,
+                    col_tile=tile_c, n_blocks=B, n_row_blocks=n_rb_f)
+    bwd = BlockSpec(n_rows=n_src_ext, n_src=n_dst, row_tile=tile_c,
+                    col_tile=tile_r, n_blocks=B, n_row_blocks=n_rb_b)
     return fwd, bwd, (ell_fwd, ell_bwd), arrays
 
 

@@ -307,11 +307,17 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
     fori/scan per column loses it all to carry re-traffic (145.7 ms).
     f32 chains also accumulate more precisely than the bf16 tree reduce.
 
+    int8 rows unroll too: exact int32 chains (the int8->int32 convert is
+    v5e-native), the caller's one per-call scale multiplies back after the
+    combine — bit-identical to the reduce path's int32 sums at ~2x the
+    row rate (256B rows move ~519M rows/s vs 268M at 512B).
+
     accum='reduce': the materialize-then-sum path, row-chunked so the
     gathered intermediate never exceeds ~chunk_gathers * H elements; it
-    serves the quantized gather modes (their convert must happen on the
-    gathered block), non-TPU backends (unrolled gathers lower poorly
-    there), and use_pallas='bucket-reduce' experiments.
+    serves fp8 gathers (their convert must happen on the gathered block;
+    e4m3 decode is VPU-emulated and loses anyway), non-TPU backends
+    (unrolled gathers lower poorly there), and use_pallas='bucket-reduce'
+    experiments.
 
     use_pallas routes the width reduction through the standard-pipeline
     Pallas kernel (ops/pallas_spmm.pallas_bucket_reduce)."""
@@ -319,38 +325,44 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
         raise ValueError(f"unknown accum mode {accum!r}")
     r = idx.shape[0]
     h_dim = hp.shape[1]
-    native = hp.dtype not in (jnp.float8_e4m3fn, jnp.int8)
     if accum == "auto":
         # unroll beats BOTH the jnp chunked reduce and pallas_bucket_reduce
         # (which only fuses the reduction, not the gather materialization),
         # so use_pallas does not disable it — pass accum='reduce' explicitly
-        # to study the materializing paths
-        accum = ("unroll" if native and jax.default_backend() == "tpu"
-                 else "reduce")
+        # to study the materializing paths. int8 rows unroll too (exact
+        # int32 chains, v5e-native converts); fp8 stays on reduce — e4m3
+        # decode is emulated on the VPU and measured 1.8x slower than bf16.
+        accum = ("unroll" if hp.dtype != jnp.float8_e4m3fn
+                 and jax.default_backend() == "tpu" else "reduce")
     BS = 16
-    if accum == "unroll" and not native:
-        # the quantized gather modes must convert on the gathered block
-        raise ValueError("accum='unroll' requires a native-dtype hp; "
-                         "quantized gathers take accum='reduce'")
+    if accum == "unroll" and hp.dtype == jnp.float8_e4m3fn:
+        raise ValueError("accum='unroll' supports native and int8 rows; "
+                         "fp8 gathers take accum='reduce'")
     if (accum == "unroll" and r > 0 and w > 1
             and (w <= BS or w % BS == 0)):
+        # int8 rows accumulate in int32 (exact, like the reduce path's
+        # int32 sums — the caller's one per-call scale multiplies back
+        # after the combine); native rows in f32 chains
+        acc_dt = jnp.int32 if hp.dtype == jnp.int8 else jnp.float32
+        out_dt = jnp.int32 if hp.dtype == jnp.int8 else hp.dtype
+
         def chain(cb, n):
-            a = hp[cb[0]].astype(jnp.float32)
+            a = hp[cb[0]].astype(acc_dt)
             for j in range(1, n):
-                a = a + hp[cb[j]].astype(jnp.float32)
+                a = a + hp[cb[j]].astype(acc_dt)
             return a
 
         if w <= BS:
-            return chain(idx.T, w).astype(hp.dtype)
+            return chain(idx.T, w).astype(out_dt)
         cols = idx.T.reshape(w // BS, BS, r)
         # derive the init from the input so the carry has the same varying
         # manual axes as the body output under shard_map (same contract as
         # block_spmm._dense_apply's acc0); the empty slice reads no data
-        acc0 = jnp.zeros((r, h_dim), jnp.float32) \
-            + jnp.sum(hp[:0]).astype(jnp.float32)
+        acc0 = jnp.zeros((r, h_dim), acc_dt) \
+            + jnp.sum(hp[:0]).astype(acc_dt)
         out, _ = jax.lax.scan(lambda acc, cb: (acc + chain(cb, BS), None),
                               acc0, cols)
-        return out.astype(hp.dtype)
+        return out.astype(out_dt)
     rows_per_chunk = max(1, chunk_gathers // max(w, 1))
     # Pallas path: on-TPU only (off-TPU falls back to the jnp reduce — Mosaic
     # doesn't lower there and the interpreter doesn't compose with shard_map's

@@ -164,6 +164,26 @@ def make_tx(cfg: Config) -> optax.GradientTransformation:
         optax.adam(cfg.lr))
 
 
+def hybrid_tiling(cfg: Config) -> tuple[int, int, int]:
+    """(effective_occupancy, tile, budget_mb) for cfg's hybrid SpMM knobs."""
+    from bnsgcn_tpu.ops.block_spmm import effective_occupancy
+    return (effective_occupancy(cfg.block_occupancy, cfg.block_tile,
+                                cfg.block_tile),
+            cfg.block_tile, cfg.block_tile_budget_mb)
+
+
+def hybrid_layout_key(cfg: Config) -> str:
+    """layout_cache key for the hybrid SpMM under cfg's tiling knobs —
+    shared with bench.py's on-disk layout pickles so they cannot drift.
+    Uses the EFFECTIVE occupancy, so auto (0) and an equal explicit value
+    share one cache entry, and pre-tile-knob keys stay valid."""
+    occ, tile, budget = hybrid_tiling(cfg)
+    key = f"hybrid:{occ}:{budget}"
+    if tile != 512:
+        key += f":t{tile}"
+    return key
+
+
 def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                    mesh: Mesh, rate: Optional[float] = None,
                    layout_cache: Optional[dict] = None
@@ -212,15 +232,17 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             dense_e, total_e = 0.0, 0.0
             for p in range(n_local):
                 pi, pe = cluster_order(art.src[p], art.dst[p], art.pad_inner,
-                                       art.n_ext)
+                                       art.n_ext, target=cfg.block_tile)
                 perms_i.append(pi)
                 perms_e.append(pe)
                 real = art.dst[p] < art.pad_inner
                 d, s = art.dst[p][real], art.src[p][real]
+                occ_eff = hybrid_tiling(cfg)[0]
                 cov = estimate_coverage(
                     pi, pe, art.pad_inner, art.n_ext, d, s,
-                    occupancy_min=cfg.block_occupancy,
-                    tile_budget_bytes=cfg.block_tile_budget_mb << 20)
+                    occupancy_min=occ_eff,
+                    tile_budget_bytes=cfg.block_tile_budget_mb << 20,
+                    tile_r=cfg.block_tile, tile_c=cfg.block_tile)
                 dense_e += cov * len(d)
                 total_e += len(d)
             if jax.process_count() > 1:
@@ -243,7 +265,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     if want_hybrid:
         from bnsgcn_tpu.ops.block_spmm import (build_block_layouts,
                                                cluster_order, make_block_spmm)
-        hyb_key = f"hybrid:{cfg.block_occupancy}:{cfg.block_tile_budget_mb}"
+        hyb_key = hybrid_layout_key(cfg)
         if layout_cache is not None and hyb_key in layout_cache:
             fwd_b, bwd_b, ell_pair, ell_arrays = layout_cache[hyb_key]
         else:
@@ -263,15 +285,17 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                 perms_i, perms_e = [], []
                 for p in range(n_local):
                     pi, pe = cluster_order(art.src[p], art.dst[p],
-                                           art.pad_inner, art.n_ext)
+                                           art.pad_inner, art.n_ext,
+                                           target=cfg.block_tile)
                     perms_i.append(pi)
                     perms_e.append(pe)
                 perms_i, perms_e = np.stack(perms_i), np.stack(perms_e)
             fwd_b, bwd_b, ell_pair, ell_arrays = build_block_layouts(
                 art.src, art.dst, art.pad_inner, art.n_ext,
                 perms_i, perms_e, agree=agree,
-                occupancy_min=cfg.block_occupancy,
-                tile_budget_bytes=cfg.block_tile_budget_mb << 20)
+                occupancy_min=hybrid_tiling(cfg)[0],
+                tile_budget_bytes=cfg.block_tile_budget_mb << 20,
+                tile_r=cfg.block_tile, tile_c=cfg.block_tile)
             if layout_cache is not None:
                 layout_cache[hyb_key] = (fwd_b, bwd_b, ell_pair,
                                          dict(ell_arrays))

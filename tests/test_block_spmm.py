@@ -14,17 +14,18 @@ from bnsgcn_tpu.ops.block_spmm import (build_block_layouts, cluster_order,
 from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
 
 
-def _hybrid_for(art, occupancy_min):
+def _hybrid_for(art, occupancy_min, tile=512):
     P = art.n_parts
     perms_i, perms_e = [], []
     for p in range(P):
         pi, pe = cluster_order(art.src[p], art.dst[p], art.pad_inner,
-                               art.n_ext, target=64)
+                               art.n_ext, target=min(tile, 64))
         perms_i.append(pi)
         perms_e.append(pe)
     fwd, bwd, ell_pair, arrays = build_block_layouts(
         art.src, art.dst, art.pad_inner, art.n_ext,
-        np.stack(perms_i), np.stack(perms_e), occupancy_min=occupancy_min)
+        np.stack(perms_i), np.stack(perms_e), occupancy_min=occupancy_min,
+        tile_r=tile, tile_c=tile)
     return fwd, bwd, ell_pair, arrays
 
 
@@ -33,6 +34,24 @@ def _dense_oracle(art, p, h_ext):
     real = art.dst[p] < art.pad_inner
     np.add.at(out, art.dst[p][real], np.asarray(h_ext)[art.src[p][real]])
     return out
+
+
+def _assert_oracle_and_grads(art, spmm, arrays, H=7, seed=0):
+    """Forward == dense oracle and d/dh == A^T cot on every part."""
+    rng = np.random.default_rng(seed)
+    for p in range(art.n_parts):
+        h = jnp.asarray(rng.normal(size=(art.n_ext, H)), jnp.float32)
+        arr_p = {k: jnp.asarray(v[p]) for k, v in arrays.items()}
+        out = np.asarray(spmm(arr_p, h))
+        np.testing.assert_allclose(out, _dense_oracle(art, p, h),
+                                   rtol=1e-4, atol=1e-4)
+        cot = rng.normal(size=out.shape).astype(np.float32)
+        gfn = jax.grad(lambda hh: jnp.sum(spmm(arr_p, hh) * cot))
+        d_h = np.asarray(gfn(h))
+        d_ref = np.zeros((art.n_ext, H))
+        real = art.dst[p] < art.pad_inner
+        np.add.at(d_ref, art.src[p][real], cot[art.dst[p][real]])
+        np.testing.assert_allclose(d_h, d_ref, rtol=1e-4, atol=1e-4)
 
 
 @pytest.mark.parametrize("graph,occ", [("sbm", 4), ("uniform", 4),
@@ -50,22 +69,21 @@ def test_hybrid_matches_oracle_and_grads(graph, occ):
     spmm = make_block_spmm(fwd, bwd, ell_pair)
     if graph == "sbm" and occ == 4:
         assert dense_edge_count(arrays, 0) > 0, "no tiles densified"
-    rng = np.random.default_rng(0)
-    H = 7
-    for p in range(art.n_parts):
-        h = jnp.asarray(rng.normal(size=(art.n_ext, H)), jnp.float32)
-        arr_p = {k: jnp.asarray(v[p]) for k, v in arrays.items()}
-        out = np.asarray(spmm(arr_p, h))
-        np.testing.assert_allclose(out, _dense_oracle(art, p, h),
-                                   rtol=1e-4, atol=1e-4)
-        # gradients: d/dh sum(out * cot) == A^T cot
-        cot = rng.normal(size=out.shape).astype(np.float32)
-        gfn = jax.grad(lambda hh: jnp.sum(spmm(arr_p, hh) * cot))
-        d_h = np.asarray(gfn(h))
-        d_ref = np.zeros((art.n_ext, H))
-        real = art.dst[p] < art.pad_inner
-        np.add.at(d_ref, art.src[p][real], cot[art.dst[p][real]])
-        np.testing.assert_allclose(d_h, d_ref, rtol=1e-4, atol=1e-4)
+    _assert_oracle_and_grads(art, spmm, arrays)
+
+
+@pytest.mark.parametrize("tile", [32, 64])
+def test_hybrid_tile_size_matches_oracle(tile):
+    """Non-default tile geometry (the bench's +t256 class, scaled to test
+    size): multiple row/col blocks per part, forward and VJP exact."""
+    g = sbm_graph(n_nodes=300, n_class=5, n_feat=6, p_in=0.15, p_out=0.003,
+                  seed=61)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=3))
+    fwd, bwd, ell_pair, arrays = _hybrid_for(art, 4, tile=tile)
+    assert fwd.row_tile == tile and fwd.n_row_blocks > 1
+    assert dense_edge_count(arrays, 0) > 0, "no tiles densified"
+    spmm = make_block_spmm(fwd, bwd, ell_pair)
+    _assert_oracle_and_grads(art, spmm, arrays)
 
 
 def test_hybrid_equals_pure_ell():
@@ -225,12 +243,10 @@ def test_chunked_dense_path_matches_oracle(dense_dtype, chunked, monkeypatch):
     import bnsgcn_tpu.ops.block_spmm as bs
     if chunked:
         monkeypatch.setattr(bs, "_tile_chunk_for", lambda *a, **k: 4)
-    monkeypatch.setattr(bs, "TR", 64)
-    monkeypatch.setattr(bs, "TC", 64)
     g = sbm_graph(n_nodes=300, n_class=5, n_feat=6, p_in=0.15, p_out=0.003,
                   seed=61)
     art = build_artifacts(g, partition_graph(g, 2, method="random", seed=3))
-    fwd, bwd, ell_pair, arrays = _hybrid_for(art, 4)
+    fwd, bwd, ell_pair, arrays = _hybrid_for(art, 4, tile=64)
     assert np.any(arrays["blk_rowb_fwd"][0][:fwd.n_blocks]
                   != arrays["blk_colb_fwd"][0][:fwd.n_blocks]), \
         "all tiles on the diagonal — wrong-slab-index bug invisible"

@@ -163,6 +163,31 @@ def test_quantized_gather_close_to_native(qmode):
     assert np.abs(grads[qmode] - grads["native"]).max() / gscale < 0.05
 
 
+def test_bucket_sum_int8_unroll_exact():
+    """int8 rows unroll in int32 chains == the reduce path's int32 sums,
+    bit-exact (both are exact integer sums of |q|<=127 over <=128 rows)."""
+    import jax.numpy as jnp
+    from bnsgcn_tpu.ops.ell import _bucket_sum
+    rng = np.random.default_rng(6)
+    for w in (2, 16, 32, 128):
+        hp = jnp.asarray(rng.integers(-127, 128, size=(400, 16)), jnp.int8)
+        idx = jnp.asarray(rng.integers(0, 400, size=(53, w)).astype(np.int32))
+        a = np.asarray(_bucket_sum(hp, idx, w, accum="unroll"))
+        b = np.asarray(_bucket_sum(hp, idx, w, accum="reduce"))
+        assert a.dtype == np.int32 and b.dtype == np.int32
+        np.testing.assert_array_equal(a, b)
+
+
+def test_bucket_sum_fp8_unroll_raises():
+    import jax.numpy as jnp
+    import pytest as _pytest
+    from bnsgcn_tpu.ops.ell import _bucket_sum
+    hp = jnp.zeros((8, 4), jnp.float8_e4m3fn)
+    idx = jnp.zeros((3, 4), jnp.int32)
+    with _pytest.raises(ValueError):
+        _bucket_sum(hp, idx, 4, accum="unroll")
+
+
 def test_bucket_sum_unroll_matches_reduce():
     """The TPU-default unrolled f32-chain accumulation equals the
     materialize-then-reduce path (f32 chains vs bf16 tree: compare in the

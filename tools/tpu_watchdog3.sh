@@ -1,0 +1,50 @@
+#!/bin/bash
+# Round-4 phase-3 watchdog: wait for the axon tunnel, confirm the headline
+# fresh (hybrid+pallas with the committed unroll accum), then drain a queue
+# of bench commands (one line of bench.py args per line) appended while new
+# candidates are prepared offline. Liveness is re-probed between runs: a
+# timed-out run can wedge the tunnel again.
+cd /root/repo
+DEADLINE=$(( $(date +%s) + ${1:-36000} ))   # default: up to 10h
+QUEUE=/root/repo/.watch_queue
+STATUS=/tmp/tpu_r4b_status
+touch "$QUEUE"
+DONE_N=0
+
+alive() {
+  timeout 180 python -c \
+    "import jax; assert jax.devices() and jax.default_backend() == 'tpu'" \
+    >/dev/null 2>&1
+}
+
+wait_alive() {
+  while true; do
+    if alive; then echo "ALIVE $(date -u +%H:%M:%S)" >> "$STATUS"; return 0; fi
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+      echo "DEADLINE $(date -u +%H:%M:%S)" >> "$STATUS"; exit 1
+    fi
+    echo "down $(date -u +%H:%M:%S)" >> "$STATUS"
+    sleep 120
+  done
+}
+
+wait_alive
+timeout 2400 python bench.py --epochs 8 --candidates hybrid+pallas \
+  --budget-s 1800 > /tmp/bench_r4b_confirm.log 2>&1
+echo "confirm rc=$?" >> "$STATUS"
+
+i=1
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  TOTAL=$(grep -c . "$QUEUE")
+  if [ "$TOTAL" -le "$DONE_N" ]; then sleep 120; continue; fi
+  LINE=$(sed -n "$((DONE_N + 1))p" "$QUEUE")
+  DONE_N=$((DONE_N + 1))
+  [ -z "$LINE" ] && continue
+  wait_alive
+  echo "run[$i]: $LINE" >> "$STATUS"
+  # shellcheck disable=SC2086
+  timeout 2400 python bench.py $LINE > "/tmp/bench_r4b_q$i.log" 2>&1
+  echo "run[$i] rc=$?" >> "$STATUS"
+  i=$((i + 1))
+done
+echo "DONE" >> "$STATUS"
