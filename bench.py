@@ -448,7 +448,11 @@ def main():
                      ("hybrid", True, "native", "native", 256),
                      # fused Pallas dense + 1-byte int8-unroll residual rows
                      ("hybrid", True, "int8", "native", 512),
-                     ("hybrid", True, "int8", "native", 256)]
+                     ("hybrid", True, "int8", "native", 256),
+                     # int8 slabs inside the fused kernel (int8 MXU, one
+                     # per-call scale) — alone and with int8 residual rows
+                     ("hybrid", True, "native", "int8", 512),
+                     ("hybrid", True, "int8", "int8", 512)]
     universe += [("hybrid", False, "native", "native", 512),
                  ("hybrid", False, "native", "native", 256),
                  ("hybrid", False, "native", "int8", 512),

@@ -402,13 +402,10 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
                     dense_dtype: str = "native", accum: str = "auto"):
     """Returns spmm(arrays, h_ext) -> [n_dst, H]: dense tiles on the MXU +
     ELL residual, custom VJP running the transposed tiles.
-    dense_dtype='int8': quantized int8 MXU tile path (see _dense_apply).
+    dense_dtype='int8': quantized int8 MXU tile path — per-slab scales on
+    the XLA formulation (_dense_apply), one per-call scale on the fused
+    Pallas kernel (pallas_block.dense_apply_pallas).
     accum: residual-ELL accumulation strategy (ops/ell._bucket_sum)."""
-    if use_pallas and dense_dtype != "native":
-        import sys
-        print(f"block_spmm: use_pallas takes the fused Pallas dense path on "
-              f"TPU, which ignores dense_dtype={dense_dtype!r} (tiles run in "
-              f"the compute dtype there)", file=sys.stderr)
     ell_fwd, ell_bwd = ell_pair
     ell = make_ell_spmm(ell_fwd, ell_bwd, len(ell_fwd.widths),
                         len(ell_bwd.widths), use_pallas=use_pallas,
@@ -431,7 +428,8 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
             from bnsgcn_tpu.ops.pallas_block import dense_apply_pallas
             return dense_apply_pallas(
                 spec_d, arrays[tiles_key], arrays[rowb_key], arrays[colb_key],
-                arrays[perm_src_key], arrays[perm_out_key], h)
+                arrays[perm_src_key], arrays[perm_out_key], h,
+                dense_dtype=dense_dtype)
         return _dense_apply(spec_d, arrays[tiles_key], arrays[rowb_key],
                             arrays[colb_key], arrays[perm_src_key],
                             arrays[perm_out_key], h, dense_dtype=dense_dtype)
