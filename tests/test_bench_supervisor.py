@@ -23,10 +23,12 @@ def _bench():
     return mod
 
 
-def _args(tmp_path, graph="dcsbm", scale=0.5, avg_degree=492):
+def _args(tmp_path, graph="dcsbm", scale=0.5, avg_degree=492, epochs=8):
     return types.SimpleNamespace(graph=graph, scale=scale,
                                  avg_degree=avg_degree,
-                                 cache_dir=str(tmp_path))
+                                 cache_dir=str(tmp_path),
+                                 epochs=epochs, dtype="bf16",
+                                 hidden=256, layers=4)
 
 
 def test_record_best_writes_and_keeps_minimum(tmp_path):
@@ -48,6 +50,26 @@ def test_record_best_writes_and_keeps_minimum(tmp_path):
                                       "best_known.json")))["dcsbm_0.5_492"]
     assert ent["value"] == 0.9 and ent["spmm"] == "hybrid"
     assert ent["last_measured_epoch"] > ent["measured_epoch"] - 1
+
+
+def test_record_anchor_and_best_share_entry_without_clobbering(tmp_path):
+    """anchor_l0/lf and value/spmm live in ONE tag entry; each record call
+    must merge, never replace (a new-best write used to wipe the anchor
+    fields the previous line just persisted)."""
+    b = _bench()
+    a = _args(tmp_path)
+    b._record_anchor(a, 3.8, 3.37)
+    b._record_best(a, 1.5, "ell")         # new best AFTER anchor
+    path = os.path.join(str(tmp_path), "best_known.json")
+    ent = json.load(open(path))["dcsbm_0.5_492"]
+    assert ent["anchor_l0"] == 3.8 and ent["value"] == 1.5
+    b._record_best(a, 0.9, "hybrid")      # better best: anchor survives
+    ent = json.load(open(path))["dcsbm_0.5_492"]
+    assert ent["anchor_l0"] == 3.8 and ent["value"] == 0.9
+    b._record_anchor(a, 3.9, 3.40)        # anchor refresh: best survives
+    ent = json.load(open(path))["dcsbm_0.5_492"]
+    assert ent["anchor_l0"] == 3.9 and ent["value"] == 0.9
+    assert ent["anchor_cfg"] == [8, "bf16", 256, 4]
 
 
 def test_load_best_known_prefers_file_over_seed(tmp_path):
