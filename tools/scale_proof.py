@@ -34,16 +34,32 @@ def rss_gb():
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
 
-def make_graph(n, deg, n_feat, n_class, seed=0):
+def make_graph(n, deg, n_feat, n_class, seed=0, feat_path=None):
     """Power-law-ish graph via inverse-transform sampling (w ~ i^-0.5):
-    node = floor(N * u^2) — O(E) with no per-draw search."""
+    node = floor(N * u^2) — O(E) with no per-draw search.
+
+    feat_path: write features to an on-disk .npy memmap instead of RAM —
+    the papers100M-class flow (feat is the biggest array and the
+    partitioner never reads it; the streaming artifact build slices it
+    per part, which pages in from disk). The 1.125B-edge rehearsal with
+    feat resident was OOM-killed at ~112 GB RSS during multilevel
+    coarsening on this 125 GB host; memmapped it fits."""
     from bnsgcn_tpu.data.graph import Graph
     rng = np.random.default_rng(seed)
     e = n * deg
     src = (n * rng.random(e) ** 2).astype(np.int64)
     dst = (n * rng.random(e) ** 2).astype(np.int64)
     label = rng.integers(0, n_class, size=n, dtype=np.int64)
-    feat = rng.standard_normal((n, n_feat), dtype=np.float32)
+    if feat_path:
+        feat = np.lib.format.open_memmap(
+            feat_path, mode="w+", dtype=np.float32, shape=(n, n_feat))
+        chunk = max(1, (1 << 28) // (n_feat * 4))        # ~256 MB slices
+        for i in range(0, n, chunk):
+            feat[i:i + chunk] = rng.standard_normal(
+                (min(chunk, n - i), n_feat), dtype=np.float32)
+        feat.flush()
+    else:
+        feat = rng.standard_normal((n, n_feat), dtype=np.float32)
     train = rng.random(n) < 0.6
     val = ~train & (rng.random(n) < 0.5)
     test = ~train & ~val
@@ -72,6 +88,10 @@ def main():
                          "the later peak-RSS prints)")
     ap.add_argument("--allow-small", action="store_true",
                     help="skip the >=1e8-edge bar (smoke-testing the tool)")
+    ap.add_argument("--feat-on-disk", action="store_true",
+                    help="generate features into a workdir .npy memmap "
+                         "(papers100M-class RAM relief: the partitioner "
+                         "never reads feat; the streaming build pages it)")
     ap.add_argument("--no-train", action="store_true",
                     help="stop after a partial (one-part) artifact load: the "
                          "billion-edge rehearsal — XLA:CPU's 8 virtual "
@@ -81,9 +101,28 @@ def main():
     args = ap.parse_args()
 
     t0 = time.time()
-    g = make_graph(args.nodes, args.deg, args.feat, 16)
+    feat_path = None
+    if args.feat_on_disk:
+        os.makedirs(args.workdir, exist_ok=True)
+        feat_path = os.path.join(args.workdir, "feat_raw.npy")
+        try:                      # tmpfs pages count AGAINST memory — the
+            fstype = None         # flag would silently provide no relief
+            dev = os.stat(args.workdir).st_dev
+            for line in open("/proc/mounts"):
+                f = line.split()
+                if os.path.exists(f[1]) and os.stat(f[1]).st_dev == dev:
+                    fstype = f[2]
+            if fstype in ("tmpfs", "ramfs"):
+                print(f"WARNING: --workdir {args.workdir} is {fstype} "
+                      f"(RAM-backed); --feat-on-disk gives no OOM relief "
+                      f"there — point --workdir at a real filesystem",
+                      file=sys.stderr, flush=True)
+        except Exception:
+            pass
+    g = make_graph(args.nodes, args.deg, args.feat, 16, feat_path=feat_path)
     print(f"[{time.time()-t0:7.1f}s] graph: {g.n_nodes} nodes, {g.n_edges} edges "
-          f"(rss {rss_gb():.1f} GB)", flush=True)
+          f"({'feat on disk' if feat_path else 'feat resident'}, "
+          f"rss {rss_gb():.1f} GB)", flush=True)
     assert args.allow_small or g.n_edges >= 100_000_000
 
     if args.method == "native":
@@ -137,10 +176,17 @@ def main():
     print(f"[{time.time()-t0:7.1f}s] streaming build: {build_t:.1f}s, "
           f"{du/1e9:.2f} GB on disk (rss {rss_gb():.1f} GB)", flush=True)
 
-    # free the raw graph before training (keep masks/labels scale honest)
+    # free the raw graph before training (keep masks/labels scale honest);
+    # the raw f32 feat memmap has no consumer past the streaming build —
+    # drop it so it can't triple the run's disk footprint at scale
     del g
     import gc
     gc.collect()
+    if feat_path:
+        try:
+            os.remove(feat_path)
+        except OSError:
+            pass
 
     if args.no_train:
         # the per-host flow at papers100M scale: each process reads ONLY its
