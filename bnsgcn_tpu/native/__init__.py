@@ -114,5 +114,7 @@ def native_comm_volume(g, part_id: np.ndarray,
     src = np.ascontiguousarray(g.src, dtype=np.int64)
     dst = np.ascontiguousarray(g.dst, dtype=np.int64)
     part = np.ascontiguousarray(part_id, dtype=np.int32)
-    return int(lib.bns_comm_volume(g.n_nodes, src.shape[0], src, dst,
-                                   np.int32(n_parts), part))
+    vol = int(lib.bns_comm_volume(g.n_nodes, src.shape[0], src, dst,
+                                  np.int32(n_parts), part))
+    return None if vol < 0 else vol   # <0 = int32-id range exceeded;
+                                      # callers fall back to the Python metric

@@ -31,9 +31,15 @@
 
 namespace {
 
+// Adjacency stores node IDS, which fit int32 (the entry point rejects
+// n_nodes > INT32_MAX): halving adj memory is what lets the multilevel
+// pipeline fit a 1B-edge graph on a 125 GB host (measured: int64 CSRs
+// alone were 36 GB there — union + out + in for the vol objective —
+// and the 1.0B-edge multilevel run OOM'd). indptr stays int64: edge
+// COUNTS exceed 2^31 at this scale.
 struct Csr {
   std::vector<int64_t> indptr;
-  std::vector<int64_t> adj;
+  std::vector<int32_t> adj;
 };
 
 // Undirected CSR over the union of both edge directions, self-loops dropped.
@@ -52,8 +58,8 @@ Csr build_csr_union(int64_t n, int64_t m, const int64_t* src,
   std::vector<int64_t> fill(g.indptr.begin(), g.indptr.end() - 1);
   for (int64_t e = 0; e < m; ++e) {
     if (src[e] == dst[e]) continue;
-    g.adj[fill[src[e]]++] = dst[e];
-    g.adj[fill[dst[e]]++] = src[e];
+    g.adj[fill[src[e]]++] = static_cast<int32_t>(dst[e]);
+    g.adj[fill[dst[e]]++] = static_cast<int32_t>(src[e]);
   }
   return g;
 }
@@ -72,7 +78,8 @@ Csr build_csr_directed(int64_t n, int64_t m, const int64_t* src,
   g.adj.resize(g.indptr[n]);
   std::vector<int64_t> fill(g.indptr.begin(), g.indptr.end() - 1);
   for (int64_t e = 0; e < m; ++e)
-    if (src[e] != dst[e]) g.adj[fill[row[e]]++] = col[e];
+    if (src[e] != dst[e])
+      g.adj[fill[row[e]]++] = static_cast<int32_t>(col[e]);
   return g;
 }
 
@@ -162,7 +169,7 @@ int64_t edge_cut_of(const Csr& uni, const int32_t* part) {
 // Weighted undirected graph. Empty wgt/vwgt mean "all ones".
 struct WGraph {
   std::vector<int64_t> indptr;
-  std::vector<int64_t> adj;
+  std::vector<int32_t> adj;   // node ids (int32 — see Csr)
   std::vector<int32_t> wgt;   // edge weights (parallel to adj)
   std::vector<int32_t> vwgt;  // vertex weights
 };
@@ -171,7 +178,7 @@ struct WGraph {
 // weights — at papers100M scale a deep copy would cost GBs.
 struct WView {
   const int64_t* indptr;
-  const int64_t* adj;
+  const int32_t* adj;
   const int32_t* wgt;    // nullptr = all ones
   const int32_t* vwgt;   // nullptr = all ones
   int64_t n_v;
@@ -268,7 +275,7 @@ WGraph hem_coarsen(const WView& g, std::vector<int64_t>& cmap,
       }
     }
     for (int64_t cu : touched) {
-      c.adj[w] = cu;
+      c.adj[w] = static_cast<int32_t>(cu);
       c.wgt[w++] = scratch[cu];
       scratch[cu] = 0;
     }
@@ -644,6 +651,10 @@ int bns_partition_v2(int64_t n_nodes, int64_t n_edges, const int64_t* src,
                      uint64_t seed, int32_t refine_passes, int32_t n_seeds,
                      int32_t multilevel, int32_t* out_part) {
   if (n_parts <= 0 || n_nodes <= 0) return 1;
+  if (n_nodes > INT32_MAX) return 3;   // adj stores int32 node ids; the
+                                       // Python binding falls back to the
+                                       // pure-Python partitioner on any
+                                       // nonzero rc
   if (n_parts == 1) {
     std::memset(out_part, 0, sizeof(int32_t) * n_nodes);
     return 0;
@@ -708,6 +719,8 @@ int64_t bns_edge_cut(int64_t n_edges, const int64_t* src, const int64_t* dst,
 int64_t bns_comm_volume(int64_t n_nodes, int64_t n_edges, const int64_t* src,
                         const int64_t* dst, int32_t n_parts,
                         const int32_t* part) {
+  if (n_nodes > INT32_MAX) return -1;  // int32 adj (binding treats <0 as
+                                       // "unavailable" and falls back)
   Csr out_csr = build_csr_directed(n_nodes, n_edges, src, dst, true);
   int64_t vol = comm_volume_of(n_nodes, out_csr, part, n_parts);
   // comm_volume in data/partitioner.py counts self-loop-free out-edges only,
