@@ -92,6 +92,10 @@ def main():
                     help="generate features into a workdir .npy memmap "
                          "(papers100M-class RAM relief: the partitioner "
                          "never reads feat; the streaming build pages it)")
+    ap.add_argument("--partition-only", action="store_true",
+                    help="stop after the partition (+ optional --metrics): "
+                         "isolates a partitioner variant's scale/memory "
+                         "behavior without re-paying the artifact build")
     ap.add_argument("--no-train", action="store_true",
                     help="stop after a partial (one-part) artifact load: the "
                          "billion-edge rehearsal — XLA:CPU's 8 virtual "
@@ -165,6 +169,10 @@ def main():
               f"({c/max(rc,1):.2f}x random), part sizes "
               f"{bal.min()}..{bal.max()} "
               f"(imbalance {bal.max()/bal.mean():.2f})", flush=True)
+
+    if args.partition_only:
+        print("SCALE PROOF OK (partition-only)")
+        return
 
     from bnsgcn_tpu.data.artifacts import build_artifacts_streaming
     path = os.path.join(args.workdir, "artifacts")
