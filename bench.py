@@ -770,6 +770,21 @@ def main():
             # gate its quantized twins are judged against
             native_l0[base], native_lf[base] = l0, lf
         log(f"  spmm={name}: {et:.4f}s/epoch loss={lf:.4f}")
+        try:
+            # structured per-candidate history (append-only) — the winner
+            # JSON line only carries the best, but cross-window analysis
+            # needs every gated measurement with its context
+            with open(os.path.join(args.cache_dir, "results_log.jsonl"),
+                      "a") as f:
+                f.write(json.dumps({
+                    "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+                    "workload": _workload_tag(args), "spmm": name,
+                    "epoch_s": round(et, 4), "min_epoch_s": round(mt, 4),
+                    "loss": round(lf, 4),
+                    "backend": jax.default_backend(),
+                    "profiled": bool(args.profile_dir)}) + "\n")
+        except Exception:
+            pass
         if best is None or et < best[0]:
             best = (et, mt, loss, name, built[-1])
             # a gated, measured epoch time: persist it so future
