@@ -152,6 +152,17 @@ def _record_anchor(args, l0: float, lf: float):
     _update_best_known(args, mutate)
 
 
+def _vname(v):
+    """Candidate display/CLI name for a (spmm, use_pallas, gather_dtype,
+    dense_dtype, tile) variant tuple — the vocabulary --candidates and
+    .watch_queue lines are written in (unit-pinned so a rename can never
+    silently invalidate a queued tunnel-window run)."""
+    return (v[0] + ("+pallas" if v[1] else "")
+            + ({"fp8": "+f8g", "int8": "+i8g"}.get(v[2], ""))
+            + ("+i8d" if v[3] == "int8" else "")
+            + (f"+t{v[4]}" if v[4] != 512 else ""))
+
+
 def _emit_result_line(value, status=None, measured_at=None, spmm=None):
     """The driver-parsed JSON line. Extra keys (status/measured_at) label
     carried-forward numbers so they can't read as fresh measurements."""
@@ -502,12 +513,6 @@ def main():
         candidates = [anchor] + universe
     else:
         candidates = [(args.spmm, False, "native", "native", 512)]
-
-    def _vname(v):
-        return (v[0] + ("+pallas" if v[1] else "")
-                + ({"fp8": "+f8g", "int8": "+i8g"}.get(v[2], ""))
-                + ("+i8d" if v[3] == "int8" else "")
-                + (f"+t{v[4]}" if v[4] != 512 else ""))
 
     if args.candidates:
         by_name = {_vname(v): v for v in universe}

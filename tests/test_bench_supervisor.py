@@ -52,6 +52,25 @@ def test_record_best_writes_and_keeps_minimum(tmp_path):
     assert ent["last_measured_epoch"] > ent["measured_epoch"] - 1
 
 
+def test_vname_vocabulary_stable():
+    """The queued-candidate vocabulary: .watch_queue lines and BENCH_NOTES
+    reference these exact names; a drift silently invalidates them."""
+    b = _bench()
+    cases = {
+        ("ell", False, "native", "native", 512): "ell",
+        ("hybrid", True, "native", "native", 512): "hybrid+pallas",
+        ("hybrid", True, "native", "native", 256): "hybrid+pallas+t256",
+        ("hybrid", True, "int8", "native", 512): "hybrid+pallas+i8g",
+        ("hybrid", True, "int8", "native", 256): "hybrid+pallas+i8g+t256",
+        ("hybrid", True, "native", "int8", 512): "hybrid+pallas+i8d",
+        ("hybrid", True, "int8", "int8", 512): "hybrid+pallas+i8g+i8d",
+        ("hybrid", False, "fp8", "int8", 512): "hybrid+f8g+i8d",
+        ("ell", False, "int8", "native", 512): "ell+i8g",
+    }
+    for v, name in cases.items():
+        assert b._vname(v) == name
+
+
 def test_record_anchor_and_best_share_entry_without_clobbering(tmp_path):
     """anchor_l0/lf and value/spmm live in ONE tag entry; each record call
     must merge, never replace (a new-best write used to wipe the anchor
