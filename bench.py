@@ -163,9 +163,13 @@ def _vname(v):
             + (f"+t{v[4]}" if v[4] != 512 else ""))
 
 
-def _emit_result_line(value, status=None, measured_at=None, spmm=None):
-    """The driver-parsed JSON line. Extra keys (status/measured_at) label
-    carried-forward numbers so they can't read as fresh measurements."""
+def _emit_result_line(value, status=None, measured_at=None, spmm=None,
+                      measured_epoch=None):
+    """The driver-parsed JSON line. Extra keys (status/measured_at/
+    measured_epoch) label carried-forward numbers so they can't read as
+    fresh measurements — and, conversely, let a reader verify HOW stale a
+    carried value is (the numeric epoch stamp is written only by a real
+    gated hardware measurement)."""
     line = {"metric": "reddit_rank_share_epoch_time_per_chip",
             "value": round(value, 4) if value else None,
             "unit": "s/epoch",
@@ -176,6 +180,13 @@ def _emit_result_line(value, status=None, measured_at=None, spmm=None):
         line["measured_at"] = measured_at
     if spmm:
         line["spmm"] = spmm
+    if isinstance(measured_epoch, (int, float)) and measured_epoch:
+        # guarded: best_known.json is hand-editable and this line must
+        # print before anything else can fail (a TypeError here would
+        # reproduce the no-JSON artifact the supervisor exists to prevent)
+        line["measured_epoch"] = measured_epoch
+        line["measured_age_h"] = round((time.time() - measured_epoch) / 3600,
+                                       1)
     print(json.dumps(line), flush=True)
 
 
@@ -209,7 +220,8 @@ def _supervise(args) -> int:
     # 1) a valid line lands FIRST: any later kill still leaves parseable data
     _emit_result_line(known.get("value"), status="carried-forward",
                       measured_at=known.get("measured_at"),
-                      spmm=known.get("spmm"))
+                      spmm=known.get("spmm"),
+                      measured_epoch=known.get("measured_epoch"))
 
     env = dict(os.environ, BNSGCN_BENCH_WORKER="1")
     attempt = 0
@@ -285,7 +297,8 @@ def _supervise(args) -> int:
     status = "partial" if last_meas > t0 else "tpu-unavailable"
     _emit_result_line(fresh.get("value"), status=status,
                       measured_at=fresh.get("measured_at"),
-                      spmm=fresh.get("spmm"))
+                      spmm=fresh.get("spmm"),
+                      measured_epoch=fresh.get("measured_epoch"))
     return 0
 
 
