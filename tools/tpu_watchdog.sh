@@ -1,4 +1,5 @@
 #!/bin/bash
+# SUPERSEDED by tools/tpu_watchdog4.sh (round 5) — kept as round-history only.
 # Wait for the axon TPU tunnel to come back, then run the headline bench
 # runs immediately. Pallas is excluded here (--no-pallas): a killed Pallas
 # remote-compile is the prime suspect for wedging the tunnel, so the

@@ -1,4 +1,5 @@
 #!/bin/bash
+# SUPERSEDED by tools/tpu_watchdog4.sh (round 5) — kept as round-history only.
 # Phase-2 hardware session: waits for tpu_watchdog.sh to finish its two
 # headline benches (DONE in /tmp/tpu_status), then runs the remaining
 # measurement stages in risk order — tune/trace/comm/microbench first,

@@ -1,4 +1,5 @@
 #!/bin/bash
+# SUPERSEDED by tools/tpu_watchdog4.sh (round 5) — kept as round-history only.
 # Round-4 phase-3 watchdog: wait for the axon tunnel, confirm the headline
 # fresh (hybrid+pallas with the committed unroll accum), then drain a queue
 # of bench commands (one line of bench.py args per line) appended while new
@@ -35,7 +36,11 @@ echo "confirm rc=$?" >> "$STATUS"
 
 i=1
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  TOTAL=$(grep -c . "$QUEUE")
+  # Physical line count: the cursor below indexes physical lines (sed -n Np)
+  # and DONE_N advances on blank lines too, so counting only non-empty lines
+  # (grep -c .) made trailing entries unreachable once a blank line appeared.
+  # awk NR (not wc -l) so a final line without a trailing newline still counts.
+  TOTAL=$(awk 'END{print NR}' "$QUEUE")
   if [ "$TOTAL" -le "$DONE_N" ]; then sleep 120; continue; fi
   LINE=$(sed -n "$((DONE_N + 1))p" "$QUEUE")
   DONE_N=$((DONE_N + 1))
