@@ -332,8 +332,9 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
         # to study the materializing paths. int8 rows unroll too (exact
         # int32 chains, v5e-native converts); fp8 stays on reduce — e4m3
         # decode is emulated on the VPU and measured 1.8x slower than bf16.
+        from bnsgcn_tpu.utils.platform import tpu_codepaths
         accum = ("unroll" if hp.dtype != jnp.float8_e4m3fn
-                 and jax.default_backend() == "tpu" else "reduce")
+                 and tpu_codepaths() else "reduce")
     BS = 16
     if accum == "unroll" and hp.dtype == jnp.float8_e4m3fn:
         raise ValueError("accum='unroll' supports native and int8 rows; "

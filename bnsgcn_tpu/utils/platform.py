@@ -13,6 +13,27 @@ import os
 import warnings
 
 
+def tpu_codepaths() -> bool:
+    """True when TPU-only code-path decisions should be taken anyway.
+
+    Two gates key off this instead of ``jax.default_backend() == "tpu"``
+    directly: the ELL accumulation auto-choice (ops/ell._bucket_sum picks
+    the unrolled chains on TPU, the materializing reduce elsewhere) and
+    bench.py's Pallas candidate vocabulary. Under BNSGCN_BENCH_PREFLIGHT=1
+    a CPU run takes the TPU decisions so the exact kernels queued for a
+    tunnel window compile and run off-hardware first — the round-4
+    scan-carry bug burned three hardware launches precisely because no CPU
+    test compiled bench's worker step with the TPU-side accumulation path.
+    (Pallas kernel BODIES still fall back to their XLA twins off-TPU:
+    Mosaic doesn't lower elsewhere, and the interpreter doesn't compose
+    with shard_map's varying-axes checks; their logic is pinned by the
+    dedicated interpret-mode unit tests instead.)"""
+    import jax
+
+    return (jax.default_backend() == "tpu"
+            or bool(os.environ.get("BNSGCN_BENCH_PREFLIGHT")))
+
+
 def honor_platform_request(strict: bool = False) -> None:
     """Re-assert the JAX_PLATFORMS env var via jax.config.
 
