@@ -88,6 +88,10 @@ class Config:
                                         # edge capture on clustered graphs at ~2x the
                                         # slab-gather traffic per tile byte)
     profile_dir: str = ""               # write a jax.profiler trace of a few epochs here
+    comm_trace: bool = True             # auto-trace a short post-warmup window and report
+                                        # trace-derived in-step Comm/Reduce columns
+                                        # ([traced]); --no-comm-trace keeps the
+                                        # exchange-only microbench ([sampled])
     remat: bool = False                 # rematerialize each layer in backward (saves HBM,
                                         # recomputes activations incl. the halo exchange)
     eval_device: str = "host"           # 'host' (background thread, full graph) |
@@ -172,6 +176,9 @@ def create_parser() -> argparse.ArgumentParser:
     p.add_argument("--spmm", type=str, default="ell",
                    choices=["ell", "hybrid", "auto", "segment"])
     both("profile-dir", type=str, default="")
+    p.add_argument("--no-comm-trace", action="store_false", dest="comm_trace",
+                   help="disable the auto-traced in-step Comm/Reduce columns")
+    p.set_defaults(comm_trace=True)
     p.add_argument("--remat", action="store_true")
     both("eval-device", type=str, default="host", choices=["host", "mesh"])
     both("halo-exchange", type=str, default="padded", choices=["padded", "shift"])

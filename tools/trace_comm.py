@@ -36,9 +36,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import glob
-import gzip
-import json
 import os
 import re
 import subprocess
@@ -46,101 +43,14 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-EXCHANGE_PAT = re.compile(r"all-to-all|collective-permute", re.I)
-REDUCE_PAT = re.compile(r"all-reduce|reduce-scatter|all-gather", re.I)
-HOST_PROGRAMS = ("train_step", "exchange_only")
-
-
-def load_trace_events(trace_dir):
-    """Newest <host>.trace.json.gz under trace_dir (chrome trace format)."""
-    paths = sorted(glob.glob(os.path.join(
-        trace_dir, "plugins/profile/*/*.trace.json.gz")), key=os.path.getmtime)
-    if not paths:
-        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
-    with gzip.open(paths[-1], "rt") as f:
-        return json.load(f).get("traceEvents", []), paths[-1]
-
-
-def _thread_names(events):
-    names = {}
-    for ev in events:
-        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
-            names[(ev["pid"], ev["tid"])] = ev["args"].get("name", "")
-    return names
-
-
-def attribute(events):
-    """Collective events per host program, with per-lane alignment.
-
-    Returns {program: {"exchange"|"reduce": {lane: [(ts, dur_us)...]},
-    "launches": N, "sweeps": N}} plus an "other" bucket for collectives
-    outside any known program span. Device events are attributed to the
-    latest host-program launch whose start ts precedes them (dispatch is
-    ordered and run.py block-waits between programs, so launch order =
-    device order). Host launch spans appear as nested duplicate events
-    ~1 us apart — deduped by a 100 us proximity window. "sweeps" counts
-    maximal consecutive runs of exchange_only launches: one Comm(s)
-    sample fires the program once per layer width back-to-back.
-    """
-    tnames = _thread_names(events)
-    raw_launches = []          # (ts, program)
-    for ev in events:
-        if ev.get("ph") != "X":
-            continue
-        name = ev.get("name", "")
-        for prog in HOST_PROGRAMS:
-            if name == f"PjitFunction({prog})" or name == f"jit_{prog}":
-                raw_launches.append((float(ev["ts"]), prog))
-    raw_launches.sort()
-    launches = []
-    for ts, prog in raw_launches:
-        if launches and launches[-1][1] == prog and ts - launches[-1][0] < 100:
-            continue
-        launches.append((ts, prog))
-    out = {p: {"exchange": {}, "reduce": {}, "launches": 0, "sweeps": 0}
-           for p in HOST_PROGRAMS + ("other",)}
-    prev = None
-    for _, prog in launches:
-        out[prog]["launches"] += 1
-        if prog == "exchange_only" and prev != "exchange_only":
-            out[prog]["sweeps"] += 1
-        prev = prog
-    starts = [ts for ts, _ in launches]
-    import bisect
-    for ev in events:
-        if ev.get("ph") != "X":
-            continue
-        name = ev.get("name", "")
-        if EXCHANGE_PAT.search(name):
-            cat = "exchange"
-        elif REDUCE_PAT.search(name):
-            cat = "reduce"
-        else:
-            continue
-        lane = (ev["pid"], tnames.get((ev["pid"], ev["tid"]), ev["tid"]))
-        if lane[1] == "python":        # host-side dispatch wrapper, not device
-            continue
-        i = bisect.bisect_right(starts, float(ev["ts"])) - 1
-        prog = launches[i][1] if i >= 0 else "other"
-        out[prog][cat].setdefault(lane, []).append(
-            (float(ev["ts"]), float(ev.get("dur", 0.0))))
-    for prog in out:
-        for cat in ("exchange", "reduce"):
-            for lane in out[prog][cat]:
-                out[prog][cat][lane].sort()
-    return out
-
-
-def program_cost(bucket, cat="exchange"):
-    """(raw_sum_us, min_over_lanes_us, events_per_lane, n_lanes)."""
-    lanes = bucket[cat]
-    if not lanes:
-        return 0.0, 0.0, 0, 0
-    raw = sum(d for evs in lanes.values() for _, d in evs)
-    n = max(len(evs) for evs in lanes.values())
-    min_est = sum(min(evs[k][1] for evs in lanes.values() if len(evs) > k)
-                  for k in range(n))
-    return raw, min_est, n, len(lanes)
+# Parsing core lives in the package (bnsgcn_tpu/utils/traceparse) so
+# run.py can derive its [traced] Comm/Reduce columns from the same
+# attribution logic this tool cross-checks; re-exported here for the
+# CLI and for tests/test_trace_comm.py.
+sys.path.insert(0, REPO)
+from bnsgcn_tpu.utils.traceparse import (  # noqa: E402,F401
+    EXCHANGE_PAT, REDUCE_PAT, HOST_PROGRAMS, load_trace_events,
+    _thread_names, attribute, program_cost, step_comm_per_epoch)
 
 
 NON_OP_LANES = ("python", "Steps", "XLA Modules", "TC Overlay")
@@ -181,7 +91,12 @@ def run_one(wire, parts, scale, dtype, workdir):
     """One short training run; returns (printed Comm(s), trace_dir).
 
     log_every=7 fires the exchange-only microbench at epoch 6 — INSIDE the
-    traced window (epochs 6-9) — so the trace holds both programs.
+    traced window (epochs 6-9) — so the trace holds both programs. 15
+    epochs so a SECOND log line lands at epoch 13, after the window closes:
+    that line carries the [traced] in-step Comm the run derives from its
+    own window, and the regex takes the LAST match — so the table compares
+    what run.py actually prints post-trace against this tool's independent
+    attribution of the same trace.
     """
     trace_dir = os.path.join(workdir, f"trace_{wire}")
     env = os.environ.copy()
@@ -194,7 +109,7 @@ def run_one(wire, parts, scale, dtype, workdir):
     cmd = [sys.executable, "-m", "bnsgcn_tpu.main",
            "--dataset", f"synth-reddit:{scale}",
            "--n-partitions", str(parts), "--model", "graphsage",
-           "--n-layers", "3", "--n-hidden", "128", "--n-epochs", "12",
+           "--n-layers", "3", "--n-hidden", "128", "--n-epochs", "15",
            "--log-every", "7", "--sampling-rate", "0.1", "--use-pp",
            "--fix-seed", "--no-eval", "--dtype", dtype,
            "--halo-wire", wire, "--profile-dir", trace_dir,
