@@ -60,6 +60,11 @@ class BlockSpec:
     col_tile: int
     n_blocks: int                  # padded dense-tile count
     n_row_blocks: int              # ceil(n_rows / row_tile)
+    max_row_dense: int = 0         # max dense edges on any output row (over
+                                   # parts; 0 = unknown, e.g. a layout cached
+                                   # before this field existed). Bounds the
+                                   # int8 Pallas path's int32 accumulator:
+                                   # |row sum| <= 127*127*max_row_dense.
 
 
 def effective_occupancy(occupancy: int, tile_r: int = TR,
@@ -102,7 +107,15 @@ def estimate_coverage(perm_rows, perm_cols, n_rows, n_src, rows, cols,
     """Fraction of edges that would land on dense MXU tiles under the
     given cluster order — the decision statistic for --spmm auto. One
     O(E) histogram pass over exactly _build_tiles' selection rule; no
-    tile stacks or residual tables are materialized."""
+    tile stacks or residual tables are materialized.
+
+    Known bias: edges beyond 127 per-(tile,row,col) multiplicity count as
+    dense here, but _build_tiles pushes that excess back to the ELL
+    residual — so on high-multiplicity multigraphs the estimate can
+    overstate coverage and flip --spmm auto toward hybrid near the
+    decision threshold. Negligible on simple graphs (every bench/reference
+    dataset); clamping would need the per-cell histogram this estimator
+    exists to avoid."""
     if len(rows) == 0:
         return 0.0
     n_cb = (n_src + tile_c - 1) // tile_c
@@ -181,6 +194,44 @@ def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
              else np.zeros(0, np.int64)))
 
 
+def _row_dense_maxima(tiles, rb, cb, n_dst, n_src_ext, tile_r, tile_c):
+    """(max fwd-row, max bwd-row) dense edge counts for one part's tile
+    stack. sum(dtype=int64) — NOT astype — so no 8x copy of the (up to
+    multi-GB) int8 stack is ever materialized."""
+    # +1 row block: stacked cached layouts pad unused tile slots with
+    # row_blk == n_row_blocks (their tiles are all-zero, so the extra row
+    # accumulates nothing and is simply not read)
+    per_row = np.zeros(((n_dst + tile_r - 1) // tile_r + 1, tile_r),
+                       np.int64)
+    np.add.at(per_row, rb, tiles.sum(axis=2, dtype=np.int64))
+    per_col = np.zeros(((n_src_ext + tile_c - 1) // tile_c + 1, tile_c),
+                       np.int64)
+    np.add.at(per_col, cb, tiles.sum(axis=1, dtype=np.int64))
+    return int(per_row.max()), int(per_col.max())
+
+
+def repair_max_row_dense(fwd: BlockSpec, bwd: BlockSpec, arrays):
+    """Fill max_row_dense on BlockSpecs unpickled from a cache written
+    before the field existed (they deserialize with the class default 0 =
+    unknown, which would silently skip the int8 Pallas overflow guard).
+    Recomputed from the cached tile stacks; returns (fwd, bwd) updated.
+    A few seconds of host numpy per load at the 2 GB-stack bench scale —
+    vs invalidating every multi-GB layout cache with a version bump."""
+    if getattr(fwd, "max_row_dense", 0) and getattr(bwd, "max_row_dense", 0):
+        return fwd, bwd
+    import dataclasses
+    tiles_all = arrays["blk_tiles_fwd"]
+    mrd_f = mrd_b = 0
+    for p in range(tiles_all.shape[0]):
+        m_f, m_b = _row_dense_maxima(
+            np.asarray(tiles_all[p]), np.asarray(arrays["blk_rowb_fwd"][p]),
+            np.asarray(arrays["blk_colb_fwd"][p]), fwd.n_rows, bwd.n_rows,
+            fwd.row_tile, fwd.col_tile)
+        mrd_f, mrd_b = max(mrd_f, m_f), max(mrd_b, m_b)
+    return (dataclasses.replace(fwd, max_row_dense=mrd_f),
+            dataclasses.replace(bwd, max_row_dense=mrd_b))
+
+
 def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
                         perm_ext, occupancy_min=512,
                         tile_budget_bytes=2 << 30, agree=None,
@@ -213,6 +264,16 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
         res_dst.append(np.concatenate([d[resid], orig_inner[xr]]))
 
     B = max(max(e[0].shape[0] for e in per_part), 1)
+    # max dense edges on any single output row, per direction (the spmm
+    # runs per part under shard_map, so the per-part max is the bound):
+    # caps the int8 Pallas accumulator at 127*127*max_row_dense
+    mrd_f = mrd_b = 0
+    for p, (tiles, rb, cb) in enumerate(per_part):
+        if tiles.shape[0] == 0:
+            continue
+        m_f, m_b = _row_dense_maxima(tiles, rb, cb, n_dst, n_src_ext,
+                                     tile_r, tile_c)
+        mrd_f, mrd_b = max(mrd_f, m_f), max(mrd_b, m_b)
     # residual geometry stats (mergeable across hosts)
     acc_f, acc_b = GeoAccum(ELL_SPLIT_CAP), GeoAccum(ELL_SPLIT_CAP)
     for p in range(P):
@@ -220,8 +281,10 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
         acc_b.add_part(np.bincount(res_src[p], minlength=n_src_ext))
     if agree is not None:
         merged = agree({"B": np.asarray([B], np.int64),
+                        "mrd": np.asarray([mrd_f, mrd_b], np.int64),
                         "geo_f": acc_f.state(), "geo_b": acc_b.state()})
         B = int(merged["B"][0])
+        mrd_f, mrd_b = int(merged["mrd"][0]), int(merged["mrd"][1])
         acc_f.merge_state(merged["geo_f"])
         acc_b.merge_state(merged["geo_b"])
     res_geometry = {"fwd": acc_f.finish(), "bwd": acc_b.finish()}
@@ -270,9 +333,11 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
         arrays[f"res_{k}"] = v
 
     fwd = BlockSpec(n_rows=n_dst, n_src=n_src_ext, row_tile=tile_r,
-                    col_tile=tile_c, n_blocks=B, n_row_blocks=n_rb_f)
+                    col_tile=tile_c, n_blocks=B, n_row_blocks=n_rb_f,
+                    max_row_dense=mrd_f)
     bwd = BlockSpec(n_rows=n_src_ext, n_src=n_dst, row_tile=tile_c,
-                    col_tile=tile_r, n_blocks=B, n_row_blocks=n_rb_b)
+                    col_tile=tile_r, n_blocks=B, n_row_blocks=n_rb_b,
+                    max_row_dense=mrd_b)
     return fwd, bwd, (ell_fwd, ell_bwd), arrays
 
 
@@ -421,10 +486,23 @@ def make_block_spmm(fwd: BlockSpec, bwd: BlockSpec, ell_pair,
         return {k[len("res_"):]: v for k, v in arrays.items()
                 if k.startswith("res_")}
 
+    # int8 Pallas accumulator bound: the fused kernel keeps exact int32 row
+    # sums of |q|<=127 x |mult|<=127 products, so a row with more than
+    # int32_max/(127*127) ~= 133k dense edges could silently wrap. The max
+    # per-row dense edge count is static in the layout (max_row_dense;
+    # getattr for layouts cached before the field existed -> 0 = unknown,
+    # guard skipped). Overflow-risk rows route to the XLA path, whose int8
+    # formulation rescales to f32 per chunk (no wrap possible).
+    _I8_ROW_CAP = (2**31 - 1) // (127 * 127)
+
+    def _i8_pallas_safe(spec_d):
+        return getattr(spec_d, "max_row_dense", 0) <= _I8_ROW_CAP
+
     def _dense(spec_d, arrays, tiles_key, rowb_key, colb_key, perm_src_key,
                perm_out_key, h):
         # Pallas fused grouped-matmul on TPU (use_pallas); XLA path elsewhere
-        if use_pallas and jax.default_backend() == "tpu":
+        if (use_pallas and jax.default_backend() == "tpu"
+                and (dense_dtype != "int8" or _i8_pallas_safe(spec_d))):
             from bnsgcn_tpu.ops.pallas_block import dense_apply_pallas
             return dense_apply_pallas(
                 spec_d, arrays[tiles_key], arrays[rowb_key], arrays[colb_key],

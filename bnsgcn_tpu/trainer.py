@@ -268,6 +268,15 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         hyb_key = hybrid_layout_key(cfg)
         if layout_cache is not None and hyb_key in layout_cache:
             fwd_b, bwd_b, ell_pair, ell_arrays = layout_cache[hyb_key]
+            if cfg.spmm_dense == "int8":
+                # layouts cached before BlockSpec.max_row_dense existed
+                # deserialize with 0 (= unknown), which would skip the
+                # int8 Pallas accumulator-overflow guard; recompute from
+                # the cached tile stacks (seconds of host numpy) and
+                # refresh the cache entry
+                from bnsgcn_tpu.ops.block_spmm import repair_max_row_dense
+                fwd_b, bwd_b = repair_max_row_dense(fwd_b, bwd_b, ell_arrays)
+                layout_cache[hyb_key] = (fwd_b, bwd_b, ell_pair, ell_arrays)
         else:
             agree = None
             if jax.process_count() > 1:

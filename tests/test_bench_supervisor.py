@@ -23,12 +23,13 @@ def _bench():
     return mod
 
 
-def _args(tmp_path, graph="dcsbm", scale=0.5, avg_degree=492, epochs=8):
+def _args(tmp_path, graph="dcsbm", scale=0.5, avg_degree=492, epochs=8,
+          model="graphsage"):
     return types.SimpleNamespace(graph=graph, scale=scale,
                                  avg_degree=avg_degree,
                                  cache_dir=str(tmp_path),
                                  epochs=epochs, dtype="bf16",
-                                 hidden=256, layers=4)
+                                 hidden=256, layers=4, model=model)
 
 
 def test_record_best_writes_and_keeps_minimum(tmp_path):
@@ -136,3 +137,20 @@ def test_corrupt_best_known_falls_back_to_seed(tmp_path):
     with open(os.path.join(str(tmp_path), "best_known.json"), "w") as f:
         f.write("{not json")
     assert b._load_best_known(a) is b._SEED_BEST["dcsbm_0.5_492"]
+
+
+def test_gat_model_gets_own_namespace_and_metric(tmp_path):
+    """--model gat must never read or clobber the GraphSAGE flagship's
+    best_known entry, and its metric line must not carry vs_baseline (the
+    reference publishes no in-repo GAT epoch time, README.md:94-95 is the
+    GraphSAGE run)."""
+    b = _bench()
+    sage, gat = _args(tmp_path), _args(tmp_path, model="gat")
+    assert b._workload_tag(gat) == b._workload_tag(sage) + "_gat"
+    b._record_best(sage, 0.5, "hybrid+pallas")
+    assert b._load_best_known(gat) is None          # no seed, no file entry
+    b._record_best(gat, 3.0, "ell")
+    assert b._load_best_known(sage)["value"] == 0.5  # untouched
+    assert b._load_best_known(gat)["value"] == 3.0
+    assert b._metric_name(gat) == "reddit_gat_rank_share_epoch_time_per_chip"
+    assert b._metric_name(sage) == "reddit_rank_share_epoch_time_per_chip"

@@ -44,13 +44,20 @@ def run(name, cmd, timeout, env=None):
                            capture_output=True, text=True)
         out = (p.stdout + p.stderr)
         # full output to disk — an OOM allocation dump can be >100 KB and
-        # would otherwise evict the per-candidate result lines
-        logdir = os.path.join(REPO, "hw_logs")
-        os.makedirs(logdir, exist_ok=True)
-        with open(os.path.join(logdir,
-                               name.replace(" ", "_").replace("/", "_")
-                               + ".log"), "w") as f:
-            f.write(out)
+        # would otherwise evict the per-candidate result lines. Own
+        # try/except: a log-write failure (e.g. disk full from multi-GB
+        # layout caches) must never reclassify a successful bench run as a
+        # failed stage (round-4 advisor finding)
+        try:
+            logdir = os.path.join(REPO, "hw_logs")
+            os.makedirs(logdir, exist_ok=True)
+            with open(os.path.join(logdir,
+                                   name.replace(" ", "_").replace("/", "_")
+                                   + ".log"), "w") as f:
+                f.write(out)
+        except OSError as ex:
+            print(f"--- {name}: log write failed ({ex}); continuing",
+                  flush=True)
         print(out[-6000:], flush=True)
         print(f"--- {name}: rc={p.returncode} in {time.time()-t0:.0f}s",
               flush=True)
