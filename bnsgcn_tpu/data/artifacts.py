@@ -254,10 +254,14 @@ def build_artifacts_streaming(g: Graph, part_id: np.ndarray, path: str,
     log(f"  [stream] {N} nodes, {g.n_edges} edges, {int(cross.sum())} cross")
 
     # boundary sets for ALL ordered pairs in one unique pass:
-    # key (u, receiver j) — uniques sorted by u, regroup by (sender p, j)
-    cu = g.src[cross].astype(np.int64)
-    cj = dst_o[cross].astype(np.int64)
-    ukey, inv = np.unique(cu * P + cj, return_inverse=True)
+    # key (u, receiver j) — uniques sorted by u, regroup by (sender p, j).
+    # Key dtype: int32 whenever N*P fits (papers100M-scale working-set
+    # relief — np.unique sorts a copy of the key array, so halving the key
+    # halves the biggest transient of this phase too)
+    kdt = np.int32 if N * P < 2**31 else np.int64
+    cu = g.src[cross].astype(kdt)
+    cj = dst_o[cross].astype(kdt)
+    ukey, inv = np.unique(cu * kdt(P) + cj, return_inverse=True)
     del cu, cj
     bu = ukey // P                                   # boundary node (global)
     bj = (ukey % P).astype(np.int32)                 # receiver
@@ -275,12 +279,16 @@ def build_artifacts_streaming(g: Graph, part_id: np.ndarray, path: str,
     n_halo = P * pad_boundary
     n_ext = pad_inner + n_halo
 
-    # per-edge extended source index (receiver-side slot space for cross edges)
-    ext_src = np.empty(g.n_edges, dtype=np.int64)
-    ext_src[~cross] = loc[g.src[~cross]]
+    # per-edge extended source index (receiver-side slot space for cross
+    # edges). Values < n_ext << 2^31, and loc < pad_inner: int32 per-edge
+    # arrays (the int64 originals were ~27 GB of the 1.6B-edge peak); loc32
+    # keeps the big fancy-index gathers producing int32 directly
+    loc32 = loc.astype(np.int32)
+    ext_src = np.empty(g.n_edges, dtype=np.int32)
+    ext_src[~cross] = loc32[g.src[~cross]]
     ext_src[cross] = pad_inner + bp[inv].astype(np.int64) * pad_boundary + slot[inv]
     del inv
-    ldst = loc[g.dst]
+    ldst = loc32[g.dst]
 
     # group edges by DESTINATION part (the owner of each edge's aggregation)
     eorder = np.argsort(dst_o, kind="stable")

@@ -68,10 +68,16 @@ class Graph:
         return self._out_deg
 
     def canonicalize(self) -> "Graph":
-        """Remove then add self-loops (reference helper/utils.py:67-69)."""
+        """Remove then add self-loops (reference helper/utils.py:67-69).
+
+        Dtype-preserving: int32 edge arrays (any n_nodes < 2^31 — even
+        papers100M's 111M) stay int32, halving the billion-edge working
+        set; promoting to int64 here was one of the 1.6B-edge rehearsal's
+        memory hogs."""
+        dt = self.src.dtype
         keep = self.src != self.dst
-        src = np.concatenate([self.src[keep], np.arange(self.n_nodes, dtype=np.int64)])
-        dst = np.concatenate([self.dst[keep], np.arange(self.n_nodes, dtype=np.int64)])
+        src = np.concatenate([self.src[keep], np.arange(self.n_nodes, dtype=dt)])
+        dst = np.concatenate([self.dst[keep], np.arange(self.n_nodes, dtype=dt)])
         return Graph(self.n_nodes, src, dst, self.feat, self.label,
                      self.train_mask, self.val_mask, self.test_mask, self.multilabel)
 

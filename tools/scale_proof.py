@@ -34,7 +34,42 @@ def rss_gb():
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
 
-def make_graph(n, deg, n_feat, n_class, seed=0, feat_path=None):
+class VirtualFeat:
+    """Deterministic id->feature generator standing in for a dataset's
+    on-disk feature matrix. The real papers100M flow reads raw features
+    from the dataset's own memmap (no extra copy); this host's free disk
+    cannot hold a 57 GB raw f32 memmap (111M x 128) AND the built
+    artifacts, so the rehearsal synthesizes rows on demand instead — same
+    access pattern (fancy indexing by global id, one part at a time), zero
+    resident or on-disk footprint. splitmix64-style hash of (id, column)
+    -> uniform floats in [-0.5, 0.5)."""
+
+    def __init__(self, n, n_feat, seed=0):
+        self.shape = (n, n_feat)
+        self.ndim = 2
+        self.dtype = np.dtype(np.float32)
+        self._seed = np.uint64(seed * 0x9E3779B97F4A7C15 + 0xD1B54A32D192ED03)
+
+    def __getitem__(self, ids):
+        ids = np.asarray(ids).astype(np.uint64, copy=False)
+        F = self.shape[1]
+        out = np.empty((len(ids), F), np.float32)
+        cols = (np.arange(F, dtype=np.uint64)
+                * np.uint64(0xBF58476D1CE4E5B9))[None, :]
+        chunk = max(1, (1 << 27) // max(F, 1))          # ~1 GB u64 temps
+        for i in range(0, len(ids), chunk):
+            x = (ids[i:i + chunk, None] * np.uint64(0x9E3779B97F4A7C15)
+                 + cols + self._seed)
+            x ^= x >> np.uint64(30)
+            x *= np.uint64(0xBF58476D1CE4E5B9)
+            x ^= x >> np.uint64(27)
+            out[i:i + chunk] = (x >> np.uint64(40)).astype(np.float32) \
+                / np.float32(2 ** 24) - np.float32(0.5)
+        return out
+
+
+def make_graph(n, deg, n_feat, n_class, seed=0, feat_path=None,
+               feat_virtual=False):
     """Power-law-ish graph via inverse-transform sampling (w ~ i^-0.5):
     node = floor(N * u^2) — O(E) with no per-draw search.
 
@@ -47,10 +82,16 @@ def make_graph(n, deg, n_feat, n_class, seed=0, feat_path=None):
     from bnsgcn_tpu.data.graph import Graph
     rng = np.random.default_rng(seed)
     e = n * deg
-    src = (n * rng.random(e) ** 2).astype(np.int64)
-    dst = (n * rng.random(e) ** 2).astype(np.int64)
+    # int32 ids whenever n fits (always for papers100M's 111M): halves the
+    # dominant edge arrays AND their canonicalize/build transients —
+    # int64 promotion was ~27 GB of the 1.6B-edge peak on this 125 GB host
+    idt = np.int32 if n < 2**31 else np.int64
+    src = (n * rng.random(e) ** 2).astype(idt)
+    dst = (n * rng.random(e) ** 2).astype(idt)
     label = rng.integers(0, n_class, size=n, dtype=np.int64)
-    if feat_path:
+    if feat_virtual:
+        feat = VirtualFeat(n, n_feat, seed=seed)
+    elif feat_path:
         feat = np.lib.format.open_memmap(
             feat_path, mode="w+", dtype=np.float32, shape=(n, n_feat))
         chunk = max(1, (1 << 28) // (n_feat * 4))        # ~256 MB slices
@@ -92,6 +133,11 @@ def main():
                     help="generate features into a workdir .npy memmap "
                          "(papers100M-class RAM relief: the partitioner "
                          "never reads feat; the streaming build pages it)")
+    ap.add_argument("--feat-virtual", action="store_true",
+                    help="synthesize feature rows on demand (VirtualFeat): "
+                         "the true-shape 1.6B x 128 rehearsal on a host "
+                         "whose free disk can't hold a raw 57 GB memmap "
+                         "next to the built artifacts")
     ap.add_argument("--partition-only", action="store_true",
                     help="stop after the partition (+ optional --metrics): "
                          "isolates a partitioner variant's scale/memory "
@@ -123,9 +169,12 @@ def main():
                       file=sys.stderr, flush=True)
         except Exception:
             pass
-    g = make_graph(args.nodes, args.deg, args.feat, 16, feat_path=feat_path)
+    g = make_graph(args.nodes, args.deg, args.feat, 16, feat_path=feat_path,
+                   feat_virtual=args.feat_virtual)
+    fmode = ("feat virtual" if args.feat_virtual
+             else "feat on disk" if feat_path else "feat resident")
     print(f"[{time.time()-t0:7.1f}s] graph: {g.n_nodes} nodes, {g.n_edges} edges "
-          f"({'feat on disk' if feat_path else 'feat resident'}, "
+          f"({fmode}, ids {g.src.dtype.name}, "
           f"rss {rss_gb():.1f} GB)", flush=True)
     assert args.allow_small or g.n_edges >= 100_000_000
 
