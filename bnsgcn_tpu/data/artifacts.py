@@ -284,6 +284,11 @@ def build_artifacts_streaming(g: Graph, part_id: np.ndarray, path: str,
     # arrays (the int64 originals were ~27 GB of the 1.6B-edge peak); loc32
     # keeps the big fancy-index gathers producing int32 directly
     loc32 = loc.astype(np.int32)
+    # fail loud rather than wrap: numpy setitem silently truncates an int64
+    # RHS into an int32 destination (2**31+5 -> -2147483643)
+    assert n_ext < 2**31, (
+        f"extended index space n_ext={n_ext} overflows the int32 per-edge "
+        f"arrays (pad_inner={pad_inner}, P={P}, pad_boundary={pad_boundary})")
     ext_src = np.empty(g.n_edges, dtype=np.int32)
     ext_src[~cross] = loc32[g.src[~cross]]
     ext_src[cross] = pad_inner + bp[inv].astype(np.int64) * pad_boundary + slot[inv]

@@ -57,6 +57,20 @@ def _load():
             ctypes.c_int32, ctypes.c_int32,
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         ]
+        try:
+            lib.bns_partition_v2_i32.restype = ctypes.c_int
+            lib.bns_partition_v2_i32.argtypes = [
+                ctypes.c_int64, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ]
+        except AttributeError:
+            # a stale cached .so predating the int32 entry: the int64 path
+            # (with its copy) still works
+            pass
         lib.bns_edge_cut.restype = ctypes.c_int64
         lib.bns_edge_cut.argtypes = [
             ctypes.c_int64,
@@ -92,10 +106,18 @@ def native_partition(g, n_parts: int, obj: str = "vol", seed: int = 0,
     lib = _load()
     if lib is None:
         return None
-    src = np.ascontiguousarray(g.src, dtype=np.int64)
-    dst = np.ascontiguousarray(g.dst, dtype=np.int64)
     out = np.empty(g.n_nodes, dtype=np.int32)
-    rc = lib.bns_partition_v2(
+    # int32 edge lists go through the zero-copy entry: the ascontiguousarray
+    # int64 promotion was ~25.6 GB of transient at the 1.6B-edge scale
+    if g.src.dtype == np.int32 and hasattr(lib, "bns_partition_v2_i32"):
+        src = np.ascontiguousarray(g.src, dtype=np.int32)
+        dst = np.ascontiguousarray(g.dst, dtype=np.int32)
+        entry = lib.bns_partition_v2_i32
+    else:
+        src = np.ascontiguousarray(g.src, dtype=np.int64)
+        dst = np.ascontiguousarray(g.dst, dtype=np.int64)
+        entry = lib.bns_partition_v2
+    rc = entry(
         g.n_nodes, src.shape[0], src, dst,
         np.int32(n_parts), np.int32(1 if obj == "cut" else 0),
         np.uint64(seed), np.int32(refine_passes),

@@ -315,12 +315,15 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
     accum='reduce': the materialize-then-sum path, row-chunked so the
     gathered intermediate never exceeds ~chunk_gathers * H elements; it
     serves fp8 gathers (their convert must happen on the gathered block;
-    e4m3 decode is VPU-emulated and loses anyway), non-TPU backends
-    (unrolled gathers lower poorly there), and use_pallas='bucket-reduce'
-    experiments.
+    e4m3 decode is VPU-emulated and loses anyway) and non-TPU backends
+    (unrolled gathers lower poorly there).
 
-    use_pallas routes the width reduction through the standard-pipeline
-    Pallas kernel (ops/pallas_spmm.pallas_bucket_reduce)."""
+    use_pallas no longer affects this function (round 5): the
+    pallas_bucket_reduce dispatch was retired — superseded by the unroll,
+    never hardware-validated; the kernel remains in ops/pallas_spmm as a
+    study artifact. The parameter stays for signature stability with
+    make_ell_spmm/make_block_spmm, whose use_pallas switches the fused
+    dense-tile kernel (ops/pallas_block), which IS hardware-validated."""
     if accum not in ("auto", "unroll", "reduce"):
         raise ValueError(f"unknown accum mode {accum!r}")
     r = idx.shape[0]
@@ -365,11 +368,14 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
                               acc0, cols)
         return out.astype(out_dt)
     rows_per_chunk = max(1, chunk_gathers // max(w, 1))
-    # Pallas path: on-TPU only (off-TPU falls back to the jnp reduce — Mosaic
-    # doesn't lower there and the interpreter doesn't compose with shard_map's
-    # vma checks), and only for widths whose (8, W, H) block fits VMEM.
-    pallas_ok = (use_pallas and w <= 1024
-                 and jax.default_backend() == "tpu")
+    # (round 5) pallas_bucket_reduce is no longer dispatched here: the
+    # unrolled chains beat it end-to-end on the v5e (it fuses only the
+    # reduction, not the gather materialization — its own docstring), its
+    # hardware validation slot never materialized across two windows, and
+    # keeping a non-winning TPU-only branch inside the accumulation
+    # hot-path risks exactly the untested-on-hardware escapes the CPU
+    # preflight exists to prevent. The kernel survives in ops/pallas_spmm
+    # as a study artifact with its interpret-mode test.
 
     def reduce_tile(g):
         if g.dtype == jnp.float8_e4m3fn:
@@ -382,9 +388,6 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
             # 1.8x SLOWER than bf16 end to end); int32 sums of <=1024
             # rows of |q|<=127 are exact
             return g.astype(jnp.int32).sum(axis=1)
-        if pallas_ok and g.shape[0] > 0 and g.shape[0] % 8 == 0:
-            from bnsgcn_tpu.ops.pallas_spmm import pallas_bucket_reduce
-            return pallas_bucket_reduce(g)
         return g.sum(axis=1)
 
     if r <= rows_per_chunk:

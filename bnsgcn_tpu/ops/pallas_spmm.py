@@ -5,15 +5,19 @@ kernel for `out[r] = sum_w h[idx[r, w]]` over one ELL bucket
 (ops/ell.py layout), with per-row HBM->VMEM DMAs double-buffered against the
 accumulation.
 
-Status: correct under the Pallas interpreter (tests/test_pallas_spmm.py). The
-axon remote-compile path in this build environment rejects *any* manual-DMA
-kernel (HTTP 500 on even a minimal fixed-row `make_async_copy` kernel), so
-hardware validation of this kernel is deferred to a direct-attached TPU. Two
-notes for that future run: (a) the XLA gather engine on a v5e sustains ~145M
-rows/s independent of index locality, so a DMA-per-row pipeline must coalesce
-sorted index runs into multi-row extents to win; (b) `pallas_bucket_reduce`
-below uses only standard block pipelines, compiles and runs on this chip, and
-is what `use_pallas` actually switches in.
+Status: STUDY ARTIFACT (round 5) — correct under the Pallas interpreter
+(tests/test_pallas_spmm.py) but wired into no training path. The unrolled
+column-chain accumulation (ops/ell._bucket_sum accum='unroll') beat the
+materializing reduce this kernel fuses by 1.9x on the v5e cap bucket and
+set the 0.573 s/epoch headline, so the `use_pallas` dispatch to
+`pallas_bucket_reduce` was retired; `use_pallas` now switches only the
+fused dense-tile kernel (ops/pallas_block), which is hardware-validated.
+Kept for two findings a future direct-attached-TPU session may build on:
+(a) the axon remote-compile path rejects *any* manual-DMA kernel (HTTP 500
+on even a minimal fixed-row `make_async_copy` kernel); (b) the XLA gather
+engine on a v5e sustains ~145M rows/s independent of index locality, so a
+DMA-per-row pipeline must coalesce sorted index runs into multi-row
+extents to win.
 """
 
 from __future__ import annotations

@@ -49,18 +49,24 @@ def test_pallas_bucket_reduce_matches_sum():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_ell_spmm_use_pallas_matches():
-    """On CPU meshes use_pallas silently falls back to the jnp reduce, so the
-    two paths must agree trivially here; the on-TPU kernel-vs-jnp equivalence
-    is exercised by bench/verify runs on the real chip."""
+def test_ell_accum_modes_agree():
+    """The ELL accumulation strategies must be numerically interchangeable:
+    'unroll' (the TPU/headline path, forced here via accum) vs 'reduce'
+    (the fp8/off-TPU materializing path). Replaces the retired
+    use_pallas-vs-jnp comparison, which became vacuous once the
+    pallas_bucket_reduce dispatch was removed from _bucket_sum (round 5 —
+    use_pallas now switches only the fused dense-tile kernel)."""
     g = synthetic_graph(n_nodes=40, avg_degree=5, n_feat=4, seed=7)
     art = build_artifacts(g, partition_graph(g, 1))
     fs, bs, arrays = build_layouts(art.src, art.dst, art.pad_inner, art.n_ext)
     from bnsgcn_tpu.ops.ell import make_ell_spmm
-    spmm_p = make_ell_spmm(fs, bs, len(fs.widths), len(bs.widths), use_pallas=True)
-    spmm_j = make_ell_spmm(fs, bs, len(fs.widths), len(bs.widths), use_pallas=False)
+    spmm_u = make_ell_spmm(fs, bs, len(fs.widths), len(bs.widths),
+                           accum="unroll")
+    spmm_r = make_ell_spmm(fs, bs, len(fs.widths), len(bs.widths),
+                           accum="reduce")
     a0 = {k: jnp.asarray(v[0]) for k, v in arrays.items()}
     h = jnp.asarray(np.random.default_rng(8).normal(
         size=(art.n_ext, 4)).astype(np.float32))
-    np.testing.assert_allclose(np.asarray(spmm_p(a0, h)), np.asarray(spmm_j(a0, h)),
+    np.testing.assert_allclose(np.asarray(spmm_u(a0, h)),
+                               np.asarray(spmm_r(a0, h)),
                                rtol=1e-5, atol=1e-5)

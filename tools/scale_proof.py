@@ -48,7 +48,11 @@ class VirtualFeat:
         self.shape = (n, n_feat)
         self.ndim = 2
         self.dtype = np.dtype(np.float32)
-        self._seed = np.uint64(seed * 0x9E3779B97F4A7C15 + 0xD1B54A32D192ED03)
+        # mask to 64 bits BEFORE np.uint64: the Python-int product overflows
+        # the C-long conversion for any seed >= 1 otherwise
+        self._seed = np.uint64(
+            (seed * 0x9E3779B97F4A7C15 + 0xD1B54A32D192ED03)
+            & 0xFFFFFFFFFFFFFFFF)
 
     def __getitem__(self, ids):
         ids = np.asarray(ids).astype(np.uint64, copy=False)
