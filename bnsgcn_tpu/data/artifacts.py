@@ -215,7 +215,7 @@ def build_artifacts_streaming(g: Graph, part_id: np.ndarray, path: str,
                               with_gat: bool = True,
                               node_mult: int = 8, boundary_mult: int = 8,
                               edge_mult: int = 8, compress: bool = False,
-                              log=None) -> None:
+                              log=None, on_part_written=None) -> None:
     """Build + write partition artifacts directly to `path`, one part resident
     at a time. Equivalent to save_artifacts(build_artifacts(g, pid), path) up
     to within-part edge order (aggregation is order-invariant), with:
@@ -371,6 +371,12 @@ def build_artifacts_streaming(g: Graph, part_id: np.ndarray, path: str,
              out_deg_ext=out_ext, src=src_p, dst=dst_p, bnd=bnd_p,
              global_nid=gnid, **masks)
         log(f"  [stream] part {p}: {k} inner, {len(eidx)} edges written")
+        if on_part_written is not None:
+            # progress / disk-budget hook: on multi-host deployments each
+            # host stores only ITS parts, so a single-host rehearsal whose
+            # disk can't hold all P part files at once measures then prunes
+            # the parts it wouldn't own (tools/scale_proof --prune-parts)
+            on_part_written(os.path.join(path, f"part{p}.npz"), p)
 
     geometry = {"fwd": geo_fwd.finish(), "bwd": geo_bwd.finish()}
     if geo_gat is not None:

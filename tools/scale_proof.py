@@ -142,6 +142,14 @@ def main():
                          "the true-shape 1.6B x 128 rehearsal on a host "
                          "whose free disk can't hold a raw 57 GB memmap "
                          "next to the built artifacts")
+    ap.add_argument("--reuse-pid", action="store_true",
+                    help="load the partition saved by a previous native run "
+                         "from workdir/pid.npy instead of re-partitioning")
+    ap.add_argument("--prune-parts", action="store_true",
+                    help="measure then delete every part file except part 0 "
+                         "as it is written: the multi-host disk story (each "
+                         "host stores only ITS parts) for single-host "
+                         "rehearsals whose disk cannot hold all P at once")
     ap.add_argument("--partition-only", action="store_true",
                     help="stop after the partition (+ optional --metrics): "
                          "isolates a partitioner variant's scale/memory "
@@ -182,7 +190,28 @@ def main():
           f"rss {rss_gb():.1f} GB)", flush=True)
     assert args.allow_small or g.n_edges >= 100_000_000
 
-    if args.method == "native":
+    if args.prune_parts and not (args.no_train or args.partition_only):
+        # the default path full-loads every part AFTER the build — pruning
+        # would make a billion-edge rehearsal crash hours in
+        sys.exit("--prune-parts requires --no-train (or --partition-only): "
+                 "the training path loads all parts")
+    pid_path = os.path.join(args.workdir, "pid.npy")
+    if args.reuse_pid and not os.path.exists(pid_path):
+        sys.exit(f"--reuse-pid: {pid_path} not found (wrong --workdir, or "
+                 f"the previous native run died before saving) — refusing "
+                 f"to silently re-partition")
+    if args.reuse_pid:
+        # a billion-edge partition is ~1-3.5k s on this host: reuse the
+        # saved one when a later phase (e.g. a disk-full artifact build)
+        # needs a retry
+        pid = np.load(pid_path)
+        assert pid.shape[0] == g.n_nodes
+        assert int(pid.max()) + 1 == args.parts, (
+            f"pid.npy was saved for P={int(pid.max()) + 1}, run asks "
+            f"--parts {args.parts}")
+        print(f"[{time.time()-t0:7.1f}s] partition reused from {pid_path}",
+              flush=True)
+    elif args.method == "native":
         # the METIS-role partitioner at papers100M scale (SURVEY §7 hard
         # part d: the reference needs a 120 GB host for DGL/METIS here)
         from bnsgcn_tpu.native import native_partition
@@ -196,6 +225,8 @@ def main():
               f"{'flat' if args.flat else 'multilevel'}, P={args.parts}, "
               f"{args.refine_passes} refine, {args.n_seeds} seeds) in "
               f"{time.time()-t1:.1f}s (rss {rss_gb():.1f} GB)", flush=True)
+        os.makedirs(args.workdir, exist_ok=True)
+        np.save(pid_path, pid)
     else:
         from bnsgcn_tpu.data.partitioner import random_partition
         pid = random_partition(g, args.parts, seed=0)
@@ -230,12 +261,23 @@ def main():
     from bnsgcn_tpu.data.artifacts import build_artifacts_streaming
     path = os.path.join(args.workdir, "artifacts")
     t1 = time.time()
+    pruned_bytes = [0]
+
+    def on_part(fpath, p):
+        if args.prune_parts and p > 0:
+            pruned_bytes[0] += os.path.getsize(fpath)
+            os.remove(fpath)
+
     build_artifacts_streaming(g, pid, path, feat_dtype="bfloat16",
-                              with_gat=False, log=None)
+                              with_gat=False, log=None, on_part_written=on_part)
     build_t = time.time() - t1
     du = sum(os.path.getsize(os.path.join(path, f)) for f in os.listdir(path))
     print(f"[{time.time()-t0:7.1f}s] streaming build: {build_t:.1f}s, "
-          f"{du/1e9:.2f} GB on disk (rss {rss_gb():.1f} GB)", flush=True)
+          f"{(du + pruned_bytes[0])/1e9:.2f} GB written "
+          f"({du/1e9:.2f} GB retained"
+          + (f", parts 1..{args.parts-1} measured then pruned"
+             if args.prune_parts else "")
+          + f") (rss {rss_gb():.1f} GB)", flush=True)
 
     # free the raw graph before training (keep masks/labels scale honest);
     # the raw f32 feat memmap has no consumer past the streaming build —
