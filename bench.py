@@ -767,7 +767,10 @@ def main():
     hyb_variants = {variant_key(v): v for v in candidates
                     if v[0] == "hybrid"}
     ell_path = os.path.join(args.cache_dir, f"layouts_ell_{tag}.pkl")
+    gat_path = os.path.join(args.cache_dir, f"layouts_gat_{tag}.pkl")
     layout_cache = _try_load(ell_path, log) or {}
+    if args.model == "gat":
+        layout_cache.update(_try_load(gat_path, log) or {})
     for v in hyb_variants.values():
         layout_cache.update(_try_load(hyb_path_for(v), log) or {})
     if layout_cache:
@@ -778,12 +781,15 @@ def main():
         nonlocal lc_keys0
         for key in set(layout_cache) - lc_keys0:
             path = (ell_path if key == "ell"
+                    else gat_path if key == "gat"
                     else hyb_path_for(hyb_variants[key]))
             _atomic_dump({key: layout_cache[key]}, path)
         lc_keys0 = set(layout_cache)
     if args.prep_only:
         for variant in candidates:
-            key = variant_key(variant)
+            # a GAT run caches under 'gat' (trainer's ELL-SpMM branch is
+            # gcn/graphsage-only, so variant_key's 'ell' never appears)
+            key = "gat" if args.model == "gat" else variant_key(variant)
             if variant[1] or key in layout_cache:   # pallas + fp8 twins
                 continue                            # share the same layouts
             t0 = time.time()

@@ -350,10 +350,18 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     if spmm_kind in ("ell", "hybrid") and spec.model == "gat":
         geo = (art.ell_geometry or {}).get("gat_fwd")
         if geo is not None or art.feat.shape[0] == art.n_parts:
-            from bnsgcn_tpu.ops.ell_attention import build_gat_layouts
-            gat_spec, gat_arrays = build_gat_layouts(
-                art.src, art.dst, art.pad_inner, art.n_ext, geometry=geo,
-                geometry_bwd=(art.ell_geometry or {}).get("bwd"))
+            if layout_cache is not None and "gat" in layout_cache:
+                gat_spec, gat_arrays = layout_cache["gat"]
+            else:
+                from bnsgcn_tpu.ops.ell_attention import build_gat_layouts
+                gat_spec, gat_arrays = build_gat_layouts(
+                    art.src, art.dst, art.pad_inner, art.n_ext, geometry=geo,
+                    geometry_bwd=(art.ell_geometry or {}).get("bwd"))
+                if layout_cache is not None:
+                    # minutes of host numpy at bench scale — cacheable like
+                    # the ell/hybrid layouts (geometry depends only on the
+                    # artifacts, not on heads/hidden/dtype)
+                    layout_cache["gat"] = (gat_spec, dict(gat_arrays))
             ell_arrays.update(gat_arrays)
             gat_keys = tuple(gat_arrays.keys())
 
