@@ -551,7 +551,11 @@ def main():
                      # int8 slabs inside the fused kernel (int8 MXU, one
                      # per-call scale) — alone and with int8 residual rows
                      ("hybrid", True, "native", "int8", 512),
-                     ("hybrid", True, "int8", "int8", 512)]
+                     ("hybrid", True, "int8", "int8", 512),
+                     # the full-lever endgame: finer tiles + int8 residual
+                     # rows + int8 slabs (queued for when the single-lever
+                     # lines confirm their independent wins)
+                     ("hybrid", True, "int8", "int8", 256)]
     universe += [("hybrid", False, "native", "native", 512),
                  ("hybrid", False, "native", "native", 256),
                  ("hybrid", False, "native", "int8", 512),

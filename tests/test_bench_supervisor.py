@@ -65,6 +65,7 @@ def test_vname_vocabulary_stable():
         ("hybrid", True, "int8", "native", 256): "hybrid+pallas+i8g+t256",
         ("hybrid", True, "native", "int8", 512): "hybrid+pallas+i8d",
         ("hybrid", True, "int8", "int8", 512): "hybrid+pallas+i8g+i8d",
+        ("hybrid", True, "int8", "int8", 256): "hybrid+pallas+i8g+i8d+t256",
         ("hybrid", False, "fp8", "int8", 512): "hybrid+f8g+i8d",
         ("ell", False, "int8", "native", 512): "ell+i8g",
     }
