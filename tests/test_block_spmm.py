@@ -334,3 +334,24 @@ def test_spmm_auto_resolution():
             place_replicated(tables, mesh),
             jax.random.key(0), jax.random.key(1))
         assert np.isfinite(float(loss))
+
+
+def test_max_row_dense_repair_matches_build():
+    """Layouts cached before BlockSpec.max_row_dense existed deserialize
+    with 0 (= unknown), which would skip the int8 Pallas overflow guard;
+    repair_max_row_dense must recompute the exact build-time values from
+    the cached tile stacks (round-4 advisor / round-5 review finding)."""
+    import dataclasses
+    from bnsgcn_tpu.ops.block_spmm import repair_max_row_dense
+    g = synthetic_graph(n_nodes=120, avg_degree=8, n_feat=4, seed=9)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=1))
+    fwd, bwd, _, arrays = _hybrid_for(art, occupancy_min=4, tile=32)
+    assert fwd.max_row_dense > 0       # build computed real values
+    stale_f = dataclasses.replace(fwd, max_row_dense=0)
+    stale_b = dataclasses.replace(bwd, max_row_dense=0)
+    rf, rb = repair_max_row_dense(stale_f, stale_b, arrays)
+    assert rf.max_row_dense == fwd.max_row_dense
+    assert rb.max_row_dense == bwd.max_row_dense
+    # already-filled specs pass through untouched
+    pf, pb = repair_max_row_dense(fwd, bwd, arrays)
+    assert pf is fwd and pb is bwd
