@@ -55,12 +55,19 @@ import numpy as np
 BASELINE_EPOCH_S = 0.3578   # reference README.md:94 (rank 0, Reddit P=2 rate=0.1)
 _CACHE_VER = 1              # bump when artifact/layout formats change
 
-# Seeded fallback if bench_cache/best_known.json is absent: the best number
-# actually measured on the v5e chip (round-2 window, ell anchor — see
-# BENCH_NOTES.md "Measured on the v5e").  Keyed by workload tag.
+# Seeded fallback if bench_cache/best_known.json is absent (e.g. a container
+# restart wipes the gitignored cache — it happened mid-queue at 07:05 on
+# 2026-07-31): the best number actually measured on the v5e chip for each
+# workload, read from the committed hardware logs. The dcsbm value is the
+# round-5 reproduction of the round-4 headline (hw_logs/r5_confirm.log:
+# hybrid+pallas 0.5715 s/epoch, independently measured twice ~12 h apart);
+# uniform is the round-2/4 ELL anchor band. Seeds carry no measured_epoch,
+# so a carried-forward line built from one is labeled status=tpu-unavailable
+# without an age — exactly as honest as a lost cache allows.
 _SEED_BEST = {
-    "dcsbm_0.5_492": {"value": 1.672, "spmm": "ell",
-                      "measured_at": "2026-07-29 round-2 v5e window"},
+    "dcsbm_0.5_492": {"value": 0.5715, "spmm": "hybrid+pallas",
+                      "measured_at": "2026-07-31 round-5 v5e window "
+                                     "(hw_logs/r5_confirm.log)"},
     "uniform_0.5_492": {"value": 1.672, "spmm": "ell",
                         "measured_at": "2026-07-29 round-2 v5e window"},
 }

@@ -1,20 +1,43 @@
 #!/bin/bash
-# (SUPERSEDED by tpu_watchdog5.sh — kept as the round-5 pre-restart artifact.)
-# Round-5 watchdog: wait for the axon tunnel, reproduce the round-4 headline
-# (hybrid+pallas, 0.573 s/epoch — a single un-reproduced measurement until
-# now), then drain .watch_queue (one line of bench.py args per line; lines
-# may be appended while this runs), and finally re-measure whatever candidate
-# holds best_known so the headline is backed by >=2 independent runs.
-# Logs go to hw_logs/ (persistent, judge-visible), not /tmp.
+# Round-5 mid-session watchdog: the container restarted at ~07:05 UTC and
+# killed tpu_watchdog4 mid-queue (run[1] had just started; bench_cache was
+# wiped with the container). The tunnel is UP and the round-4 headline was
+# already REPRODUCED this round (hw_logs/r5_confirm.log, 0.5715 s/epoch at
+# 03:43), so this watchdog skips the confirm stage and drains .watch_queue
+# immediately, then re-measures whatever holds best_known so the final
+# headline is backed by >=2 fresh runs. Logs go to hw_logs/.
 cd /root/repo
 DEADLINE=$(( $(date +%s) + ${1:-43200} ))   # default: up to 12h
 QUEUE=/root/repo/.watch_queue
-STATUS=/root/repo/hw_logs/r5_watchdog_status
+STATUS=/root/repo/hw_logs/r5_watchdog5_status
 LOGDIR=/root/repo/hw_logs
 mkdir -p "$LOGDIR"
 touch "$QUEUE"
-DONE_N=0
 RAN_ANY=0    # set only when a bench run took a FRESH measurement — gates repro
+# Per-launch log stamp: a relaunch after a container restart must never
+# truncate the previous session's evidence logs (they are the committed
+# audit trail for the headline numbers).
+STAMP=$(date -u +%H%M%S)
+# Single instance only: two drains with independent cursors would run
+# bench.py concurrently on the one chip and corrupt each other's timings.
+exec 9>/root/repo/.watchdog5.lock
+if ! flock -n 9; then
+  echo "LOCKED-OUT $(date -u +%H:%M:%S) (another instance running)" \
+    >> "$STATUS"
+  exit 1
+fi
+# Queue cursor persists across same-container relaunches so a relaunch
+# does not replay already-measured lines. (A full container restart
+# reverts the repo to the git checkout and loses it — by then the queue
+# itself needs human re-triage anyway.) Delete the cursor file when
+# rewriting the queue from scratch.
+CURSOR=/root/repo/.watch_queue.cursor
+DONE_N=$(cat "$CURSOR" 2>/dev/null || echo 0)
+case "$DONE_N" in ''|*[!0-9]*) DONE_N=0;; esac
+# When a run ends with no fresh measurement (tunnel died mid-run), its
+# line is re-appended to the queue; the budget caps how much window a
+# deterministically-failing line can burn (preflight makes that rare).
+RETRY_BUDGET=12
 
 # bench.py's supervisor exits 0 even on its carried-forward fallback, so rc
 # alone cannot distinguish "measured on hardware" from "emitted stale data".
@@ -61,13 +84,23 @@ bench_timeout_for() {
   echo $((budget + 1800))
 }
 
-wait_alive
-echo "confirm start $(date -u +%H:%M:%S)" >> "$STATUS"
-timeout "$(bench_timeout_for '--budget-s 1800')" python bench.py --epochs 8 \
-  --candidates hybrid+pallas --budget-s 1800 > "$LOGDIR/r5_confirm.log" 2>&1
-rc=$?
-echo "confirm rc=$rc fresh=$(fresh_ok "$LOGDIR/r5_confirm.log" && echo 1 || echo 0)" >> "$STATUS"
-fresh_ok "$LOGDIR/r5_confirm.log" && RAN_ANY=1
+# Headline best_known spmm — exact headline tag, NOT a startswith scan: the
+# queue also writes dcsbm-mid_0.5_492 and dcsbm_0.5_492_gat entries, and a
+# prefix match could disarm the repro on the wrong workload's spmm. The
+# json read never needs the TPU backend: force CPU + timeout so a wedged
+# tunnel can't hang the command substitution forever.
+best_spmm() {
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu timeout 60 \
+    python - <<'EOF'
+import json
+try:
+    with open("bench_cache/best_known.json") as f:
+        d = json.load(f)
+    print(d.get("dcsbm_0.5_492", {}).get("spmm", ""))
+except Exception:
+    print("")
+EOF
+}
 
 REPRO_DONE=0
 REPRO_TRIES=0
@@ -88,57 +121,63 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       # by any run's anchor stage, so run without --candidates/--skip-anchor.
       # The json read never needs the TPU backend: force CPU + timeout so a
       # wedged tunnel can't hang the command substitution forever.
-      BEST=$(PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu timeout 60 \
-             python - <<'EOF'
-import json
-try:
-    with open("bench_cache/best_known.json") as f:
-        d = json.load(f)
-    rec = next((v for k, v in d.items() if k.startswith("dcsbm")), {})
-    print(rec.get("spmm", ""))
-except Exception:
-    print("")
-EOF
-)
+      BEST=$(best_spmm)
       if [ -n "$BEST" ]; then
         wait_alive
         echo "repro[$ri][$BEST] start $(date -u +%H:%M:%S)" >> "$STATUS"
         if [ "$BEST" = "ell" ]; then
           timeout "$(bench_timeout_for '--budget-s 1800')" python bench.py \
-            --epochs 8 --budget-s 1800 > "$LOGDIR/r5_repro_$ri.log" 2>&1
+            --epochs 8 --budget-s 1800 > "$LOGDIR/r5w5_${STAMP}_repro_$ri.log" 2>&1
         else
           timeout "$(bench_timeout_for '--budget-s 1800')" python bench.py \
             --epochs 8 --skip-anchor --candidates "$BEST" --budget-s 1800 \
-            > "$LOGDIR/r5_repro_$ri.log" 2>&1
+            > "$LOGDIR/r5w5_${STAMP}_repro_$ri.log" 2>&1
         fi
         rc=$?
-        FRESH=$(fresh_ok "$LOGDIR/r5_repro_$ri.log" && echo 1 || echo 0)
+        FRESH=$(fresh_ok "$LOGDIR/r5w5_${STAMP}_repro_$ri.log" && echo 1 || echo 0)
         echo "repro[$ri] rc=$rc fresh=$FRESH" >> "$STATUS"
         ri=$((ri + 1))
         REPRO_TRIES=$((REPRO_TRIES + 1))
-        # Disarm only when a fresh measurement actually landed; a failed or
-        # carried-forward repro retries next pass (wait_alive gates it, and
-        # REPRO_TRIES caps the burn at 3 attempts per arm cycle).
-        [ "$FRESH" -eq 1 ] && REPRO_DONE=1
+        # Disarm only when a fresh measurement actually landed AND the best
+        # spmm did not change: an ell-branch repro runs the full default
+        # sweep, which can crown a NEW winner with only one fresh run —
+        # that new best then needs its own reproduction pass.
+        if [ "$FRESH" -eq 1 ]; then
+          NEWBEST=$(best_spmm)
+          if [ -z "$NEWBEST" ] || [ "$NEWBEST" = "$BEST" ]; then
+            REPRO_DONE=1
+          else
+            echo "repro crowned new best $NEWBEST; re-arming" >> "$STATUS"
+            REPRO_TRIES=0
+          fi
+        fi
       fi
     fi
     sleep 120; continue
   fi
   LINE=$(sed -n "$((DONE_N + 1))p" "$QUEUE")
   DONE_N=$((DONE_N + 1))
+  echo "$DONE_N" > "$CURSOR"
   [ -z "$LINE" ] && continue
   wait_alive
   echo "run[$i]: $LINE" >> "$STATUS"
   # shellcheck disable=SC2086
   timeout "$(bench_timeout_for "$LINE")" python bench.py $LINE \
-    > "$LOGDIR/r5_q$i.log" 2>&1
+    > "$LOGDIR/r5w5_${STAMP}_q$i.log" 2>&1
   rc=$?
-  FRESH=$(fresh_ok "$LOGDIR/r5_q$i.log" && echo 1 || echo 0)
+  FRESH=$(fresh_ok "$LOGDIR/r5w5_${STAMP}_q$i.log" && echo 1 || echo 0)
   echo "run[$i] rc=$rc fresh=$FRESH" >> "$STATUS"
   if [ "$FRESH" -eq 1 ]; then
     RAN_ANY=1
     REPRO_DONE=0   # new measurements may change best_known; re-arm the repro
     REPRO_TRIES=0
+  elif [ "$RETRY_BUDGET" -gt 0 ]; then
+    # no fresh measurement (tunnel died mid-run, or a compile crash the
+    # preflight could not see): give the line another shot at the back of
+    # the queue rather than silently losing its candidates for the session
+    RETRY_BUDGET=$((RETRY_BUDGET - 1))
+    printf '%s\n' "$LINE" >> "$QUEUE"
+    echo "run[$i] requeued (retry budget $RETRY_BUDGET)" >> "$STATUS"
   fi
   i=$((i + 1))
 done
