@@ -46,14 +46,18 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import pickle
 import sys
 import time
 
 import numpy as np
 
 BASELINE_EPOCH_S = 0.3578   # reference README.md:94 (rank 0, Reddit P=2 rate=0.1)
-_CACHE_VER = 1              # bump when artifact/layout formats change
+
+# versioned-pickle cache helpers shared with the trainer's --cache-dir
+# layout persistence (bnsgcn_tpu/utils/diskcache.py)
+from bnsgcn_tpu.utils.diskcache import (atomic_dump as _atomic_dump,
+                                        disk_cached as _disk_cached,
+                                        try_load as _try_load)
 
 # Seeded fallback if bench_cache/best_known.json is absent (e.g. a container
 # restart wipes the gitignored cache — it happened mid-queue at 07:05 on
@@ -176,15 +180,23 @@ def _record_anchor(args, l0: float, lf: float):
     _update_best_known(args, mutate)
 
 
+def _vhalo(v):
+    """Halo-exchange strategy of a variant tuple. Variants grew a 6th field
+    for the ragged exchange; 5-tuples (every pre-existing name) mean
+    'padded', so queued lines and best_known entries stay valid."""
+    return v[5] if len(v) > 5 else "padded"
+
+
 def _vname(v):
     """Candidate display/CLI name for a (spmm, use_pallas, gather_dtype,
-    dense_dtype, tile) variant tuple — the vocabulary --candidates and
-    .watch_queue lines are written in (unit-pinned so a rename can never
+    dense_dtype, tile[, halo]) variant tuple — the vocabulary --candidates
+    and .watch_queue lines are written in (unit-pinned so a rename can never
     silently invalidate a queued tunnel-window run)."""
     return (v[0] + ("+pallas" if v[1] else "")
             + ({"fp8": "+f8g", "int8": "+i8g"}.get(v[2], ""))
             + ("+i8d" if v[3] == "int8" else "")
-            + (f"+t{v[4]}" if v[4] != 512 else ""))
+            + (f"+t{v[4]}" if v[4] != 512 else "")
+            + ({"ragged": "+rag", "shift": "+shift"}.get(_vhalo(v), "")))
 
 
 def _emit_result_line(args, value, status=None, measured_at=None, spmm=None,
@@ -328,42 +340,6 @@ def _supervise(args) -> int:
     return 0
 
 
-def _try_load(path: str, log):
-    """Versioned-pickle read; None on missing/stale/corrupt (a bad cache
-    must never kill the bench)."""
-    if not os.path.exists(path):
-        return None
-    t0 = time.time()
-    try:
-        with open(path, "rb") as f:
-            ver, obj = pickle.load(f)
-        if ver != _CACHE_VER:
-            log(f"  stale cache version {ver} at {path}; ignoring")
-            return None
-        log(f"  loaded {os.path.basename(path)} in {time.time() - t0:.1f}s")
-        return obj
-    except Exception as ex:
-        log(f"  cache read failed at {path} ({type(ex).__name__})")
-        return None
-
-
-def _disk_cached(path: str, build, log):
-    """Pickle-backed build cache (artifacts + SpMM layouts are minutes of
-    numpy at bench scale — pre-buildable on CPU while the TPU idles)."""
-    obj = _try_load(path, log)
-    if obj is None:
-        obj = build()
-        _atomic_dump(obj, path)
-    return obj
-
-
-def _atomic_dump(obj, path: str):
-    tmp = f"{path}.{os.getpid()}.tmp"   # per-PID: prep-only and a watchdog
-    with open(tmp, "wb") as f:          # bench may write concurrently
-        pickle.dump((_CACHE_VER, obj), f, protocol=4)
-    os.replace(tmp, path)
-
-
 def _features(label: np.ndarray, n_feat=602, n_class=41) -> np.ndarray:
     """Label-correlated features from a dedicated RNG stream — identical on
     cold and warm runs (the cache stores only edges/labels/masks)."""
@@ -451,7 +427,12 @@ def main():
                          "compiler has wedged the TPU tunnel when killed "
                          "mid-compile; measurement sessions run it last, "
                          "separately)")
-    ap.add_argument("--cache-dir", type=str, default="./bench_cache")
+    ap.add_argument("--cache-dir", type=str,
+                    default=os.environ.get("BNSGCN_CACHE_DIR")
+                    or "./bench_cache",
+                    help="artifact/layout/best-known cache dir (default "
+                         "$BNSGCN_CACHE_DIR or ./bench_cache; point it at a "
+                         "persistent volume to survive container wipes)")
     ap.add_argument("--profile-dir", type=str, default="",
                     help="diagnostic: write a jax.profiler trace of each "
                          "measured candidate's first epoch chunk to "
@@ -470,7 +451,10 @@ def main():
                     help="comma list restricting/ordering the SpMM variants "
                          "to measure after the ell anchor (names as logged: "
                          "hybrid, hybrid+i8g+i8d, hybrid+f8g+i8d, hybrid+f8g, "
-                         "ell+i8g, ell+f8g, hybrid+pallas, hybrid+pallas+i8g)"
+                         "ell+i8g, ell+f8g, hybrid+pallas, hybrid+pallas+i8g; "
+                         "a +rag suffix runs the same recipe under the "
+                         "exact-bytes ragged halo exchange: hybrid+rag, "
+                         "ell+rag, hybrid+pallas+rag)"
                          " — for short TPU-tunnel windows. The pallas names "
                          "only exist on a TPU backend without --no-pallas; "
                          "an all-unknown list is an error (exit 2), never a "
@@ -563,6 +547,12 @@ def main():
                      # rows + int8 slabs (queued for when the single-lever
                      # lines confirm their independent wins)
                      ("hybrid", True, "int8", "int8", 256)]
+    if pallas_ok:
+        # exact-bytes ragged halo exchange under the headline recipe: on the
+        # single bench chip this measures the ragged collective's dispatch
+        # cost inside the real train step (cross-chip bytes need a pod);
+        # ragged_all_to_all itself is v5e-validated (hw_session_r4.log)
+        universe += [("hybrid", True, "native", "native", 512, "ragged")]
     universe += [("hybrid", False, "native", "native", 512),
                  ("hybrid", False, "native", "native", 256),
                  ("hybrid", False, "native", "int8", 512),
@@ -570,7 +560,9 @@ def main():
                  ("hybrid", False, "fp8", "int8", 512),
                  ("hybrid", False, "fp8", "native", 512),
                  ("ell", False, "int8", "native", 512),
-                 ("ell", False, "fp8", "native", 512)]
+                 ("ell", False, "fp8", "native", 512),
+                 ("hybrid", False, "native", "native", 512, "ragged"),
+                 ("ell", False, "native", "native", 512, "ragged")]
     anchor = ("ell", False, "native", "native", 512)
     if args.spmm == "hybrid":
         candidates = [anchor] + universe
@@ -634,8 +626,9 @@ def main():
     skey, dkey = jax.random.key(0), jax.random.key(1)
 
     def make_cfg(variant):
-        spmm, use_pallas, gather, dense, tile = variant
+        spmm, use_pallas, gather, dense, tile = variant[:5]
         return Config(model=args.model,
+                      halo_exchange=_vhalo(variant),
                       heads=2 if args.model == "gat" else 1,
                       n_layers=args.layers,
                       n_hidden=args.hidden, use_pp=True, dropout=0.5,

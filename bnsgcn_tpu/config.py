@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -98,13 +99,23 @@ class Config:
                                         # 'mesh' (distributed full-rate eval on the parts mesh)
     halo_exchange: str = "padded"       # 'padded' (one all_to_all, uniform pad) |
                                         # 'shift' (P-1 ppermute rounds, per-shift pads —
-                                        #  wire bytes track skewed boundary sizes)
+                                        #  wire bytes track skewed boundary sizes) |
+                                        # 'ragged' (one lax.ragged_all_to_all, exact
+                                        #  per-pair bytes; emulated off-TPU) |
+                                        # 'auto' (pick per run from wire_bytes() +
+                                        #  hop-count tiebreak; logged at startup)
     halo_wire: str = "native"           # interconnect payload dtype for the training halo
                                         # exchange: 'native' | 'bf16' | 'fp8' (e4m3 + scales)
     streaming_artifacts: str = "auto"   # 'auto' (> 30M edges) | 'always' | 'never':
                                         # build partition artifacts one part at a time
     feat_storage: str = "float32"       # on-disk feature dtype for streamed artifacts
                                         # ('bfloat16' halves papers100M-scale feature IO)
+    cache_dir: str = ""                 # persistent dir for SpMM layout pickles
+                                        # (content-addressed by hybrid_layout_key);
+                                        # default from $BNSGCN_CACHE_DIR — point it at
+                                        # a persistent volume and the ~980 s hybrid
+                                        # layout build survives container wipes.
+                                        # Empty = rebuild every run.
 
     # fields injected from partition meta.json at load time
     # (reference helper/utils.py:134-138)
@@ -181,12 +192,15 @@ def create_parser() -> argparse.ArgumentParser:
     p.set_defaults(comm_trace=True)
     p.add_argument("--remat", action="store_true")
     both("eval-device", type=str, default="host", choices=["host", "mesh"])
-    both("halo-exchange", type=str, default="padded", choices=["padded", "shift"])
+    both("halo-exchange", type=str, default="padded",
+         choices=["padded", "shift", "ragged", "auto"])
     both("halo-wire", type=str, default="native", choices=["native", "bf16", "fp8", "int8"])
     both("streaming-artifacts", type=str, default="auto",
          choices=["auto", "always", "never"])
     both("feat-storage", type=str, default="float32",
          choices=["float32", "bfloat16"])
+    both("cache-dir", type=str,
+         default=os.environ.get("BNSGCN_CACHE_DIR", ""))
     both("edge-chunk", type=int, default=0)
     both("use-pallas", action="store_true", default=False)
     both("spmm-gather", type=str, default="native", choices=["native", "fp8", "int8"])

@@ -320,7 +320,7 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
 
     use_pallas no longer affects this function (round 5): the
     pallas_bucket_reduce dispatch was retired — superseded by the unroll,
-    never hardware-validated; the kernel remains in ops/pallas_spmm as a
+    never hardware-validated; the kernel remains in tools/pallas_spmm as a
     study artifact. The parameter stays for signature stability with
     make_ell_spmm/make_block_spmm, whose use_pallas switches the fused
     dense-tile kernel (ops/pallas_block), which IS hardware-validated."""
@@ -374,7 +374,7 @@ def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,
     # hardware validation slot never materialized across two windows, and
     # keeping a non-winning TPU-only branch inside the accumulation
     # hot-path risks exactly the untested-on-hardware escapes the CPU
-    # preflight exists to prevent. The kernel survives in ops/pallas_spmm
+    # preflight exists to prevent. The kernel survives in tools/pallas_spmm
     # as a study artifact with its interpret-mode test.
 
     def reduce_tile(g):

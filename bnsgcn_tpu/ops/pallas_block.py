@@ -4,7 +4,7 @@ The XLA formulation (ops/block_spmm._dense_apply) materializes the slab
 gather [B, TC, H] and the per-tile partial products [B, TR, H] f32 in HBM
 before the segment-sum. This kernel fuses all three: a standard block
 pipeline (NO manual DMA — this environment's remote compiler rejects
-make_async_copy kernels, see ops/pallas_spmm.py) over grid=(B,) where
+make_async_copy kernels, see tools/pallas_spmm.py) over grid=(B,) where
 
   * the adjacency tile [TR, TC] int8 streams in per step,
   * the X slab block index comes from the scalar-prefetched colb table
@@ -72,7 +72,7 @@ def pallas_tile_matmul(tiles: jax.Array, rowb: jax.Array, colb: jax.Array,
     )
     try:
         # under shard_map with check_vma the out aval must carry the same
-        # varying-mesh-axes set as the input (see ops/pallas_spmm.py)
+        # varying-mesh-axes set as the input (see tools/pallas_spmm.py)
         out_shape = jax.ShapeDtypeStruct((n_row_blocks + 1, TR, H),
                                          out_dtype,
                                          vma=jax.typeof(x_slabs).vma)

@@ -25,8 +25,9 @@ on part j holds the k-th entry of q's boundary list toward j.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -48,7 +49,14 @@ class HaloSpec:
       * 'shift'  — P-1 `ppermute` rounds, round k padded only to
         max_p send_size[p, (p+k)%P]: wire bytes track the *actual* skewed
         boundary sizes, the TPU analog of the reference's exact per-pair
-        isend sizes (helper/feature_buffer.py:111-121).
+        isend sizes (helper/feature_buffer.py:111-121);
+      * 'ragged' — ONE `lax.ragged_all_to_all` carrying each (sender, peer)
+        pair's exact send_size[p, j] rows: shift's exact bytes without its
+        P-1 serialized hops. Offsets/sizes are trace-time constants
+        (`pair_send`). Native on TPU backends that ship the collective;
+        elsewhere (XLA:CPU, old jax) a numerically identical emulation
+        routes the same rows over the padded all_to_all through the same
+        pack/unpack geometry, so the strategy is CPU-mesh-testable.
     `wire` picks the payload dtype on the interconnect:
       * 'native' — h.dtype as-is;
       * 'bf16'   — cast to bfloat16 on the wire;
@@ -64,9 +72,11 @@ class HaloSpec:
     pad_send: int                      # S_pad: per-pair send padding (<= B_pad)
     axis_name: str = "parts"
     exact: bool = False                # rate == 1.0: identity ordering, no top_k
-    strategy: str = "padded"           # 'padded' | 'shift'
+    strategy: str = "padded"           # 'padded' | 'shift' | 'ragged'
     wire: str = "native"               # 'native' | 'bf16' | 'fp8' | 'int8'
     shift_pads: tuple = ()             # [P-1] per-shift send widths (strategy='shift')
+    pair_send: tuple = ()              # [P][P] exact per-pair send sizes (python
+                                       # ints — the ragged geometry is static)
 
     @property
     def n_halo(self) -> int:
@@ -98,10 +108,14 @@ def make_halo_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
     for k in range(1, P):
         m = int(max(send_size[p, (p + k) % P] for p in range(P)))
         shift_pads.append(0 if m == 0 else min(((m + 7) // 8) * 8, pad_send))
+    assert strategy in ("padded", "shift", "ragged"), (
+        f"unresolved halo strategy {strategy!r} (resolve 'auto' via "
+        f"select_halo_strategy before make_halo_spec)")
     spec = HaloSpec(
         n_parts=P, pad_inner=pad_inner, pad_boundary=pad_boundary,
         pad_send=pad_send, axis_name=axis_name, exact=exact,
         strategy=strategy, wire=wire, shift_pads=tuple(shift_pads),
+        pair_send=tuple(map(tuple, send_size.tolist())),
     )
     tables = {"n_b": jnp.asarray(n_b, jnp.int32),
               "send_size": jnp.asarray(send_size, jnp.int32),
@@ -109,14 +123,101 @@ def make_halo_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
     return spec, tables
 
 
+def _ragged_exact_rows(pair_send, n_parts: int) -> int:
+    """Bottleneck device's exact off-diagonal send rows — what the ragged
+    collective puts on the wire (matches the hw-probe accounting,
+    hw_logs/hw_session_r4.log:399: `send.sum(1).max()` with a zero diagonal)."""
+    S = np.asarray(pair_send, dtype=np.int64).reshape(n_parts, n_parts).copy()
+    np.fill_diagonal(S, 0)
+    return int(S.sum(axis=1).max()) if S.size else 0
+
+
 def wire_bytes(spec: HaloSpec, width: int, native_bytes: int = 4) -> int:
-    """Per-device interconnect payload bytes of ONE forward exchange at the
-    given feature width (excluding the local self-block and the [P] f32
-    scales, which are negligible). The backward exchange costs the same."""
+    """Per-device payload bytes of ONE forward exchange at the given feature
+    width (excluding the [P] f32 scales, which are negligible). The backward
+    exchange costs the same.
+
+    Accounting matches the hardware probe (hw_logs/hw_session_r4.log:399):
+    'padded' counts the full P-block tiled all_to_all buffer (the self block
+    rides the same payload even though its hop is chip-local); 'shift' counts
+    its per-diagonal pads; 'ragged' counts the bottleneck device's exact
+    off-diagonal rows."""
     b = {"native": native_bytes, "bf16": 2, "fp8": 1, "int8": 1}[spec.wire]
     if spec.strategy == "shift":
         return sum(spec.shift_pads) * width * b
-    return (spec.n_parts - 1) * spec.pad_send * width * b
+    if spec.strategy == "ragged":
+        return _ragged_exact_rows(spec.pair_send, spec.n_parts) * width * b
+    return spec.n_parts * spec.pad_send * width * b
+
+
+# auto-selection thresholds: ragged must save >=5% of padded's cross-chip
+# bytes to be worth leaving the best-tuned dense collective; shift pays P-1
+# serialized hop latencies for the same bytes as ragged, so it is only
+# picked when ragged is unavailable AND the skew saving is large (>=25%).
+RAGGED_MIN_SAVING = 0.05
+SHIFT_MIN_SAVING = 0.25
+
+
+def ragged_native_ok() -> bool:
+    """True when `lax.ragged_all_to_all` will lower natively here: the op
+    exists in this jax AND the backend is TPU (UNIMPLEMENTED on XLA:CPU —
+    hw_logs/hw_session_r4.log probe note). BNSGCN_RAGGED_EMULATE=1 forces
+    the emulation path for debugging."""
+    if not hasattr(jax.lax, "ragged_all_to_all"):
+        return False
+    if os.environ.get("BNSGCN_RAGGED_EMULATE"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def ragged_auto_eligible() -> bool:
+    """Whether `--halo-exchange auto` may pick 'ragged'. The emulated path is
+    numerically exact everywhere but ships padded bytes PLUS pack/unpack
+    gathers — strictly worse than 'padded' on any real accelerator — so auto
+    only picks ragged where the native collective lowers, or on the CPU test
+    mesh (bytes are fictional there and the strategy must stay selectable
+    for the tier-1 suite). An explicit --halo-exchange ragged still runs the
+    emulation anywhere."""
+    return ragged_native_ok() or jax.default_backend() == "cpu"
+
+
+def select_halo_strategy(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
+                         rate: float, wire: str = "native",
+                         allow_ragged: bool = True) -> tuple[str, str]:
+    """Resolve `--halo-exchange auto`: pick padded/shift/ragged from the
+    `wire_bytes()` estimate (width/dtype cancel, so the pick is width-free)
+    plus the hop-count tiebreak documented above. Returns (strategy, reason).
+
+    Byte comparison is against padded's CROSS-CHIP rows (P-1 blocks; the
+    self block never leaves the chip), not its full buffer accounting —
+    otherwise ragged would "win" 1/P even on perfectly balanced partitions.
+    Deterministic in the (global) n_b table: every host of a multi-host run
+    resolves identically."""
+    # one spec carries all three strategies' geometry (pad_send, shift_pads
+    # and pair_send are derived unconditionally)
+    spec = make_halo_spec(n_b, pad_inner, pad_boundary, rate, wire=wire)[0]
+    P = spec.n_parts
+    padded_rows = (P - 1) * spec.pad_send
+    shift_rows = sum(spec.shift_pads)
+    ragged_rows = _ragged_exact_rows(spec.pair_send, P)
+    if P <= 1 or padded_rows == 0:
+        return "padded", "single partition / empty halo"
+    if allow_ragged and ragged_rows < (1.0 - RAGGED_MIN_SAVING) * padded_rows:
+        return "ragged", (
+            f"exact {ragged_rows} rows vs padded {padded_rows} "
+            f"({ragged_rows / padded_rows:.0%}), one collective")
+    if shift_rows < (1.0 - SHIFT_MIN_SAVING) * padded_rows:
+        return "shift", (
+            f"per-diagonal {shift_rows} rows vs padded {padded_rows} "
+            f"({shift_rows / padded_rows:.0%}), worth P-1 serialized hops"
+            + ("" if allow_ragged else "; ragged collective unavailable"))
+    if allow_ragged:
+        return "padded", (
+            f"balanced boundaries (ragged {ragged_rows}/{padded_rows} rows "
+            f"saves <{RAGGED_MIN_SAVING:.0%}); one dense collective")
+    return "padded", (
+        f"ragged collective unavailable and shift {shift_rows}/{padded_rows} "
+        f"rows saves <{SHIFT_MIN_SAVING:.0%} (not worth P-1 serialized hops)")
 
 
 @dataclass
@@ -250,6 +351,131 @@ def _ppermute_wire_bwd(spec, k, _, g):
 _ppermute_wire.defvjp(_ppermute_wire_fwd, _ppermute_wire_bwd)
 
 
+# ----------------------------------------------------------------------------
+# 'ragged' strategy: ONE collective carrying each pair's exact send_size[p,j]
+# rows. All geometry (offsets, sizes, buffer bounds) is derived from the
+# static pair_send table, so the per-device offset vectors are plain gathers
+# of trace-time constants by axis_index — exactly the static-shape discipline
+# the padded path established, minus its padding bytes.
+# ----------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _ragged_geometry(sizes: tuple):
+    """(S, in_off, recv_off, T_pad, R_pad) for a [P][P] pair-size tuple.
+
+    S[p][j]     rows p sends j;
+    in_off[p]   exclusive row-cumsum of S[p] — chunk offsets in p's operand;
+    recv_off[p] exclusive row-cumsum of S[:,p] — chunk offsets in p's output;
+    T_pad/R_pad lane-aligned uniform operand/output bounds (SPMD shapes must
+    agree across devices; the ragged sizes say how much of each is real)."""
+    S = np.asarray(sizes, dtype=np.int64)
+    in_off = np.zeros_like(S)
+    in_off[:, 1:] = np.cumsum(S, axis=1)[:, :-1]
+    R = np.ascontiguousarray(S.T)
+    recv_off = np.zeros_like(R)
+    recv_off[:, 1:] = np.cumsum(R, axis=1)[:, :-1]
+    pad8 = lambda n: max(8, ((int(n) + 7) // 8) * 8)
+    return (S, in_off, recv_off,
+            pad8(S.sum(axis=1).max()), pad8(R.sum(axis=1).max()))
+
+
+def _transpose_sizes(sizes: tuple) -> tuple:
+    return tuple(zip(*sizes))
+
+
+def _ragged_pack(off_row, size_row, n_pad: int, blocks: jax.Array) -> jax.Array:
+    """[P, S, d] per-peer blocks -> [n_pad, d] ragged buffer: chunk j's rows
+    land contiguously at off_row[j]; slack rows are zero."""
+    P, S, d = blocks.shape
+    t = jnp.arange(n_pad)
+    j = jnp.clip(jnp.searchsorted(off_row, t, side="right") - 1, 0, P - 1)
+    i = t - off_row[j]
+    src = jnp.where(i < size_row[j], j * S + i, P * S)
+    flat = jnp.concatenate(
+        [blocks.reshape(P * S, d), jnp.zeros((1, d), blocks.dtype)])
+    return flat[src]
+
+
+def _ragged_unpack(off_row, size_row, S: int, buf: jax.Array) -> jax.Array:
+    """Inverse of `_ragged_pack`: [n_pad, d] -> [P, S, d]; rows beyond each
+    chunk's ragged size come back zero."""
+    n_pad, d = buf.shape
+    P = off_row.shape[0]
+    i = jnp.arange(S)
+    idx = jnp.where(i[None, :] < size_row[:, None],
+                    off_row[:, None] + i[None, :], n_pad)
+    flat = jnp.concatenate([buf, jnp.zeros((1, d), buf.dtype)])
+    return flat[idx.reshape(-1)].reshape(P, S, d)
+
+
+def _ragged_a2a(spec: HaloSpec, sizes: tuple, payload: jax.Array) -> jax.Array:
+    """The ragged collective with a block interface: [P, S, d] per-peer send
+    blocks -> [P, S, d] per-sender recv blocks (rows >= sizes[q][me] zero).
+
+    Native path: pack to the ragged operand and issue ONE
+    `lax.ragged_all_to_all` (v5e-validated, hw_logs/hw_session_r4.log).
+    Emulated path (XLA:CPU / old jax): the same pack/unpack geometry wrapped
+    around a padded all_to_all — identical numerics, so the CPU mesh tests
+    exercise the real offset math even where the op cannot lower."""
+    P, S, d = payload.shape
+    S_mat, in_off, recv_off, T_pad, R_pad = _ragged_geometry(sizes)
+    me = jax.lax.axis_index(spec.axis_name)
+    in_off_d = jnp.asarray(in_off, jnp.int32)[me]          # [P]
+    send_d = jnp.asarray(S_mat, jnp.int32)[me]             # [P] rows to peer j
+    recv_off_d = jnp.asarray(recv_off, jnp.int32)[me]      # [P]
+    recv_d = jnp.asarray(S_mat.T, jnp.int32)[me]           # [P] rows from q
+    operand = _ragged_pack(in_off_d, send_d, T_pad, payload)
+    if ragged_native_ok():
+        # output_offsets[j] = where MY chunk lands on receiver j
+        out_off_d = jnp.asarray(recv_off.T, jnp.int32)[me]
+        output = jnp.zeros((R_pad, d), payload.dtype)
+        out = jax.lax.ragged_all_to_all(
+            operand, output, in_off_d, send_d, out_off_d, recv_d,
+            axis_name=spec.axis_name)
+    else:
+        blocks = _ragged_unpack(in_off_d, send_d, S, operand)
+        recvb = jax.lax.all_to_all(blocks.reshape(P * S, d), spec.axis_name,
+                                   0, 0, tiled=True).reshape(P, S, d)
+        out = _ragged_pack(recv_off_d, recv_d, R_pad, recvb)
+    return _ragged_unpack(recv_off_d, recv_d, S, out)
+
+
+def _ragged_wire_impl(spec: HaloSpec, sizes: tuple, send: jax.Array) -> jax.Array:
+    P = send.shape[0]
+    if spec.wire == "native":
+        payload, scale = send, None
+    else:
+        payload, scale = _quant(send, spec.wire)
+    recv = _ragged_a2a(spec, sizes, payload)
+    rscale = None
+    if scale is not None:
+        # per-(sender, peer) block scales ride a tiny dense all_to_all, as
+        # on the padded path (P floats vs megabytes of rows)
+        rscale = jax.lax.all_to_all(scale.reshape(P, 1), spec.axis_name,
+                                    0, 0, tiled=True).reshape(P, 1, 1)
+    return _dequant(recv, rscale, send.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ragged_wire(spec: HaloSpec, sizes: tuple, send: jax.Array) -> jax.Array:
+    return _ragged_wire_impl(spec, sizes, send)
+
+
+def _ragged_wire_fwd(spec, sizes, send):
+    return _ragged_wire_impl(spec, sizes, send), None
+
+
+def _ragged_wire_bwd(spec, sizes, _, g):
+    # the transpose of a ragged all_to_all is the ragged all_to_all with the
+    # pair-size matrix transposed: the cotangent of what I received from q
+    # (S[q][me] rows) routes back to q. Quantized wires re-quantize g with
+    # its OWN scales, never the activations' (the fp8-comm pitfall).
+    return (_ragged_wire_impl(spec, _transpose_sizes(sizes), g),)
+
+
+_ragged_wire.defvjp(_ragged_wire_fwd, _ragged_wire_bwd)
+
+
 def halo_apply(spec: HaloSpec, plan: HaloPlan, h: jax.Array) -> jax.Array:
     """One layer's halo exchange: h [pad_inner, d] -> h_ext [pad_inner + n_halo, d].
 
@@ -278,6 +504,18 @@ def halo_apply(spec: HaloSpec, plan: HaloPlan, h: jax.Array) -> jax.Array:
                 recv = _ppermute_wire(spec, k, send)
             slots_k = jax.lax.dynamic_index_in_dim(plan.slots, frm, 0, False)[:Sk]
             buf = buf.at[slots_k].add(recv)
+        return jnp.concatenate([h, buf[:-1]], axis=0)
+
+    if spec.strategy == "ragged":
+        # exact per-pair rows in ONE collective (runs even at P=1 so a
+        # single-chip bench measures the real dispatch cost); the valid
+        # sample rows are the FIRST send_size[me, j] of each S_pad block
+        # (sampling.pair_sample contract), which is what makes the ragged
+        # chunks contiguous prefixes
+        send = (h[plan.sel] * plan.weight[..., None]).astype(h.dtype)
+        recv = _ragged_wire(spec, spec.pair_send, send).reshape(P * Sp, d)
+        buf = jnp.zeros((spec.n_halo + 1, d), dtype=h.dtype)
+        buf = buf.at[plan.slots.reshape(-1)].add(recv)
         return jnp.concatenate([h, buf[:-1]], axis=0)
 
     # padded: one tiled all_to_all, uniform S_pad per pair.

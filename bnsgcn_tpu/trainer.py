@@ -38,7 +38,8 @@ from bnsgcn_tpu.ops.spmm import agg_sum
 from bnsgcn_tpu.parallel.halo import (HaloSpec, full_rate_spec, halo_apply,
                                       make_halo_plan, make_halo_spec,
                                       precompute_exchange)
-from bnsgcn_tpu.parallel.mesh import make_parts_mesh, parts_sharding, replicated_sharding
+from bnsgcn_tpu.parallel.mesh import (make_parts_mesh, parts_sharding,
+                                       replicated_sharding, shard_map)
 
 # --spmm auto picks the dense-tile hybrid when at least this fraction of
 # edges would densify onto MXU tiles (v5e measured: hybrid wins at 78.5%
@@ -197,8 +198,22 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     — SpMM layout construction (minutes at bench scale) is memoized under
     the spmm kind, so e.g. bench's ell and ell+f8g candidates build once."""
     rate = cfg.sampling_rate if rate is None else rate
+    halo_strategy = cfg.halo_exchange
+    if halo_strategy == "auto":
+        # byte estimate + hop tiebreak over the GLOBAL n_b table, so every
+        # host of a multi-host run resolves to the same strategy; eligibility
+        # keeps a TPU without the native ragged collective off the emulation
+        # (which ships padded bytes)
+        from bnsgcn_tpu.parallel.halo import (ragged_auto_eligible,
+                                              select_halo_strategy)
+        halo_strategy, why = select_halo_strategy(
+            art.n_b, art.pad_inner, art.pad_boundary, rate,
+            wire=cfg.halo_wire, allow_ragged=ragged_auto_eligible())
+        if jax.process_index() == 0:
+            print(f"halo-exchange=auto: {why} -> {halo_strategy}",
+                  file=sys.stderr)
     hspec, tables = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, rate,
-                                   strategy=cfg.halo_exchange, wire=cfg.halo_wire)
+                                   strategy=halo_strategy, wire=cfg.halo_wire)
     hspec_full, tables_full = full_rate_spec(art.n_b, art.pad_inner, art.pad_boundary)
     n_train = max(art.n_train, 1)
     multilabel = art.multilabel
@@ -404,7 +419,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         loss = jax.lax.psum(ls / n_train, axis)
         return loss, new_state
 
-    sharded_loss = jax.shard_map(
+    sharded_loss = shard_map(
         local_loss, mesh=mesh,
         in_specs=(rep, rep, blk_spec, rep, rep, rep, rep),
         out_specs=(rep, rep))
@@ -437,7 +452,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     @jax.jit
     def forward(params, state, epoch, blk, tables, sample_key, drop_key=None):
         """Training-mode forward (per-epoch sampling active), logits per part."""
-        f = jax.shard_map(
+        f = shard_map(
             partial(local_forward),
             mesh=mesh,
             in_specs=(rep, rep, blk_spec, rep, rep, rep, rep),
@@ -462,7 +477,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     @jax.jit
     def eval_forward(params, state, blk, tables_full):
-        f = jax.shard_map(local_eval, mesh=mesh,
+        f = shard_map(local_eval, mesh=mesh,
                           in_specs=(rep, rep, blk_spec, rep),
                           out_specs=blk_spec)
         return f(params, state, blk, tables_full)
@@ -488,7 +503,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     @jax.jit
     def precompute(blk, tables_full):
-        f = jax.shard_map(local_precompute, mesh=mesh,
+        f = shard_map(local_precompute, mesh=mesh,
                           in_specs=(blk_spec, rep), out_specs=blk_spec)
         return f(blk, tables_full)
 
@@ -505,7 +520,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     def exchange_only(blk, tables, epoch, sample_key, width):
         """Isolated halo exchange x n_graph_layers — the Comm(s) microbench."""
-        f = jax.shard_map(partial(local_exchange_only, width=width),
+        f = shard_map(partial(local_exchange_only, width=width),
                           mesh=mesh,
                           in_specs=(blk_spec, rep, rep, rep), out_specs=blk_spec)
         return f(blk, tables, epoch, sample_key)

@@ -1,2 +1,21 @@
-from bnsgcn_tpu.utils.metrics import calc_acc, micro_f1
-from bnsgcn_tpu.utils.timers import CommTimer, EpochTimer, device_memory_stats
+"""Utility re-exports, resolved lazily (PEP 562): `utils.diskcache` must be
+importable from bench.py's supervisor path without dragging in jax (timers
+imports it), and the axon sitecustomize makes eager jax imports risky when
+the TPU tunnel is wedged."""
+
+_EXPORTS = {
+    "calc_acc": "bnsgcn_tpu.utils.metrics",
+    "micro_f1": "bnsgcn_tpu.utils.metrics",
+    "CommTimer": "bnsgcn_tpu.utils.timers",
+    "EpochTimer": "bnsgcn_tpu.utils.timers",
+    "device_memory_stats": "bnsgcn_tpu.utils.timers",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,53 @@
+"""Versioned-pickle disk cache for expensive host-side builds.
+
+One shared implementation for bench.py's artifact/layout caches and the
+trainer's `--cache-dir` / $BNSGCN_CACHE_DIR layout persistence (the hybrid
+SpMM layout build is ~980 s at bench scale — pointing the cache at a
+persistent volume makes it survive container wipes). Keys are the caller's
+content-addressed names (trainer.hybrid_layout_key), so entries cannot
+drift across the two users.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+
+CACHE_VER = 1               # bump when artifact/layout formats change
+
+
+def try_load(path: str, log=print):
+    """Versioned-pickle read; None on missing/stale/corrupt (a bad cache
+    must never kill the caller)."""
+    if not os.path.exists(path):
+        return None
+    t0 = time.time()
+    try:
+        with open(path, "rb") as f:
+            ver, obj = pickle.load(f)
+        if ver != CACHE_VER:
+            log(f"  stale cache version {ver} at {path}; ignoring")
+            return None
+        log(f"  loaded {os.path.basename(path)} in {time.time() - t0:.1f}s")
+        return obj
+    except Exception as ex:
+        log(f"  cache read failed at {path} ({type(ex).__name__})")
+        return None
+
+
+def atomic_dump(obj, path: str):
+    tmp = f"{path}.{os.getpid()}.tmp"   # per-PID: prep-only and a watchdog
+    with open(tmp, "wb") as f:          # bench may write concurrently
+        pickle.dump((CACHE_VER, obj), f, protocol=4)
+    os.replace(tmp, path)
+
+
+def disk_cached(path: str, build, log=print):
+    """Pickle-backed build cache (artifacts + SpMM layouts are minutes of
+    numpy at bench scale — pre-buildable on CPU while the TPU idles)."""
+    obj = try_load(path, log)
+    if obj is None:
+        obj = build()
+        atomic_dump(obj, path)
+    return obj

@@ -39,6 +39,7 @@ def graph():
 
 @pytest.mark.parametrize("model,use_pp,norm,spmm,dtype,remat,n_linear,edge_chunk",
                          CASES)
+@pytest.mark.quickgate
 def test_one_step_finite(graph, model, use_pp, norm, spmm, dtype, remat,
                          n_linear, edge_chunk):
     g = graph
@@ -92,6 +93,12 @@ HALO_CASES = [
     ("graphsage", "ell",    "shift",  "bf16",   "float32"),
     ("gat",       "ell",    "shift",  "fp8",    "float32"),
     ("graphsage", "hybrid", "shift",  "fp8",    "bfloat16"),
+    # exact-bytes ragged exchange x models x wires, and the auto selector
+    # resolving inside build_step_fns
+    ("graphsage", "ell",    "ragged", "int8",   "float32"),
+    ("gcn",       "hybrid", "ragged", "bf16",   "bfloat16"),
+    ("gat",       "ell",    "ragged", "fp8",    "float32"),
+    ("graphsage", "hybrid", "auto",   "native", "float32"),
 ]
 
 

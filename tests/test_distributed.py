@@ -17,7 +17,7 @@ from bnsgcn_tpu.data.graph import sbm_graph, synthetic_graph
 from bnsgcn_tpu.data.partitioner import partition_graph
 from bnsgcn_tpu.models.gnn import ModelSpec, init_params
 from bnsgcn_tpu.parallel.halo import halo_apply, make_halo_plan, make_halo_spec
-from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+from bnsgcn_tpu.parallel.mesh import make_parts_mesh, shard_map
 from bnsgcn_tpu.ops.spmm import agg_sum
 from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns, init_training,
                                 place_blocks, place_replicated)
@@ -90,12 +90,19 @@ def test_p4_rate1_forward_equals_p1(model, use_pp, norm):
     np.testing.assert_allclose(l4, l1, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("model,use_pp", [("gcn", True), ("graphsage", True),
-                                          ("graphsage", False)])
-def test_p4_rate1_train_step_equals_p1(model, use_pp):
+@pytest.mark.quickgate
+@pytest.mark.parametrize("model,use_pp,halo",
+                         [("gcn", True, "padded"), ("graphsage", True, "padded"),
+                          ("graphsage", False, "padded"),
+                          # rate-1.0 'ragged' must reproduce exact full-graph
+                          # training like the padded path (ISSUE 1 acceptance)
+                          ("graphsage", True, "ragged"),
+                          ("graphsage", False, "ragged")])
+def test_p4_rate1_train_step_equals_p1(model, use_pp, halo):
     g = synthetic_graph(n_nodes=80, avg_degree=5, n_feat=5, n_class=3, seed=32)
     cfg = Config(model=model, dropout=0.0, use_pp=use_pp, norm="layer",
-                 n_train=g.n_train, lr=0.01, sampling_rate=1.0)
+                 n_train=g.n_train, lr=0.01, sampling_rate=1.0,
+                 halo_exchange=halo)
     spec = ModelSpec(model, (5, 8, 3), norm="layer", dropout=0.0, use_pp=use_pp,
                      train_size=g.n_train)
     params, state = init_params(jax.random.key(9), spec)
@@ -122,6 +129,7 @@ def test_p4_rate1_train_step_equals_p1(model, use_pp):
                  results[4][1], results[1][1])
 
 
+@pytest.mark.quickgate
 def test_bns_unbiasedness():
     """E over epochs of (sampled, 1/ratio-scaled) halo aggregation equals the
     full-rate aggregation (SURVEY §4: unbiasedness of BNS)."""
@@ -142,7 +150,7 @@ def test_bns_unbiasedness():
             plan = make_halo_plan(spec, tables, b["bnd"], epoch, base)
             hx = halo_apply(spec, plan, b["feat"])
             return agg_sum(hx, b["src"], b["dst"], spec.pad_inner)[None]
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             local, mesh=mesh, in_specs=(P("parts"), P(), P()),
             out_specs=P("parts")))
 

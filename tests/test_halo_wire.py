@@ -1,12 +1,16 @@
 """Halo exchange strategies and wire formats.
 
-  * 'shift' (P-1 per-diagonal ppermute rounds) computes EXACTLY the same
-    extended features and gradients as the padded all_to_all — only the
-    collective decomposition and padding differ;
+  * 'shift' (P-1 per-diagonal ppermute rounds) and 'ragged' (ONE exact-bytes
+    ragged collective) compute EXACTLY the same extended features and
+    gradients as the padded all_to_all — only the collective decomposition
+    and padding differ (strategy x wire matrix below, on the 8-device mesh);
   * wire='fp8' (e4m3 + per-block scales) stays within quantization tolerance
     forward and backward, with fresh scales on the gradient hop;
-  * wire_bytes tracks real skewed boundary sizes under 'shift' and the
-    dtype compression factor.
+  * wire_bytes tracks real skewed boundary sizes under 'shift'/'ragged' and
+    the dtype compression factor, pinned to the hardware-probed 38%-of-padded
+    ratio on the logged skewed profile (hw_logs/hw_session_r4.log:399);
+  * `--halo-exchange auto` picks ragged on that profile, padded on balanced
+    boundaries, and falls back per the documented hop-count tiebreak.
 
 Reference equivalents: exact per-pair isend sizes helper/feature_buffer.py:111-121
 (skew-proportional), payload dtype has no reference equivalent (capability
@@ -24,8 +28,9 @@ from bnsgcn_tpu.data.artifacts import build_artifacts
 from bnsgcn_tpu.data.graph import sbm_graph, synthetic_graph
 from bnsgcn_tpu.data.partitioner import partition_graph
 from bnsgcn_tpu.parallel.halo import (halo_apply, make_halo_plan,
-                                      make_halo_spec, wire_bytes)
-from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+                                      make_halo_spec, select_halo_strategy,
+                                      wire_bytes)
+from bnsgcn_tpu.parallel.mesh import make_parts_mesh, shard_map
 
 
 def _skewed_graph():
@@ -56,12 +61,97 @@ def _apply_and_grad(art, spec, tables, mesh, feat, epoch=3):
         (_, hx), g = jax.value_and_grad(loss_fn, has_aux=True)(b["feat"])
         return hx[None], g[None]
 
-    f = jax.jit(jax.shard_map(local, mesh=mesh,
+    f = jax.jit(shard_map(local, mesh=mesh,
                               in_specs=(P("parts"), P()), out_specs=(P("parts"), P("parts"))))
     from bnsgcn_tpu.trainer import place_blocks, place_replicated
     blk = place_blocks({"feat": feat, "bnd": art.bnd}, mesh)
     hx, gr = f(blk, place_replicated(tables, mesh))
     return np.asarray(hx), np.asarray(gr)
+
+
+# ----------------------------------------------------------------------------
+# strategy x wire matrix on the full 8-device mesh: every decomposition under
+# every payload dtype must agree (forward AND backward) with padded+native
+# ----------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def skew8():
+    """8-part skewed partition (sizes 90..8) + the padded+native reference
+    exchange results, shared across the matrix cases."""
+    g = synthetic_graph(n_nodes=240, avg_degree=7, n_feat=6, seed=46,
+                        power_law=True)
+    sizes = [90, 50, 30, 20, 16, 14, 12, 8]
+    pid = np.repeat(np.arange(8), sizes).astype(np.int32)
+    art = build_artifacts(g, pid)
+    mesh = make_parts_mesh(8)
+    feat = art.feat.astype(np.float32)
+    sp_ref, tb = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, 0.5)
+    hx_ref, g_ref = _apply_and_grad(art, sp_ref, tb, mesh, feat)
+    return art, mesh, feat, tb, hx_ref, g_ref
+
+
+@pytest.mark.parametrize("wire", ["native", "bf16", "int8", "fp8"])
+@pytest.mark.parametrize("strategy", ["padded", "shift", "ragged"])
+def test_strategy_wire_matrix_matches_padded_native(skew8, strategy, wire):
+    art, mesh, feat, tb, hx_ref, g_ref = skew8
+    sp, _ = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, 0.5,
+                           strategy=strategy, wire=wire)
+    hx, gr = _apply_and_grad(art, sp, tb, mesh, feat)
+    # native decompositions are exact; quantized wires carry per-block-scale
+    # rounding (e4m3 ~2-3 significant digits)
+    tol = {"native": 1e-6, "bf16": 0.02, "int8": 0.05, "fp8": 0.06}[wire]
+    scale = np.abs(hx_ref).max() + 1e-9
+    assert np.abs(hx - hx_ref).max() / scale < tol, (strategy, wire, "fwd")
+    gscale = np.abs(g_ref).max() + 1e-9
+    assert np.abs(gr - g_ref).max() / gscale < tol, (strategy, wire, "bwd")
+    if wire != "native":
+        assert not np.allclose(hx, hx_ref), (strategy, wire, "no-op?")
+
+
+@pytest.mark.quickgate
+def test_wire_bytes_ragged_pins_hw_profile():
+    """wire_bytes on the hardware-probed skewed profile (P=8, rate=0.1,
+    H=256 bf16 — hw_logs/hw_session_r4.log:399) must reproduce the logged
+    numbers: padded 20.5 MB, ragged exact 7.8 MB = 38% (<= 40%), and the
+    auto selector must pick ragged there."""
+    P_ = 8
+    rng = np.random.default_rng(1)
+    base = (50000 / np.arange(1, P_) ** 0.8).astype(np.int64)
+    n_b = np.zeros((P_, P_), np.int64)
+    for i in range(P_):
+        n_b[i, np.arange(P_) != i] = rng.permutation(base)
+    sp_pad, _ = make_halo_spec(n_b, 0, 50048, 0.1)
+    sp_rag, _ = make_halo_spec(n_b, 0, 50048, 0.1, strategy="ragged")
+    bp = wire_bytes(sp_pad, 256, 2)
+    br = wire_bytes(sp_rag, 256, 2)
+    assert abs(bp / 1e6 - 20.5) < 0.3, bp      # the logged padded MB
+    assert abs(br / 1e6 - 7.8) < 0.3, br       # the logged exact MB
+    assert br <= 0.40 * bp, (br, bp)
+    strategy, why = select_halo_strategy(n_b, 0, 50048, 0.1)
+    assert strategy == "ragged", why
+    # byte estimate is dtype/width-free: same pick for every wire
+    for wire in ("bf16", "int8", "fp8"):
+        assert select_halo_strategy(n_b, 0, 50048, 0.1, wire=wire)[0] == "ragged"
+
+
+@pytest.mark.quickgate
+def test_auto_selection_tiebreaks():
+    """Balanced boundaries -> padded (ragged saves <5%); ragged disallowed
+    on a skew that shift's per-diagonal pads cannot capture -> padded with
+    the hop-count rationale; ragged disallowed on a diagonal-banded skew
+    (each shift round nearly empty) -> shift."""
+    nb_bal = np.full((4, 4), 64, np.int64)
+    np.fill_diagonal(nb_bal, 0)
+    assert select_halo_strategy(nb_bal, 0, 64, 1.0)[0] == "padded"
+    # banded: only the +1 diagonal is big, the rest tiny -> shift pads track it
+    nb_band = np.full((4, 4), 8, np.int64)
+    np.fill_diagonal(nb_band, 0)
+    for p in range(4):
+        nb_band[p, (p + 1) % 4] = 512
+    s, why = select_halo_strategy(nb_band, 0, 512, 1.0, allow_ragged=False)
+    assert s == "shift", why
+    # and with ragged allowed it wins outright (same bytes, one hop)
+    assert select_halo_strategy(nb_band, 0, 512, 1.0)[0] == "ragged"
 
 
 @pytest.mark.parametrize("rate", [1.0, 0.5])

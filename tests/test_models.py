@@ -70,6 +70,7 @@ def test_gcn_eval_matches_dense(norm):
     np.testing.assert_allclose(logits, expect, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.quickgate
 @pytest.mark.parametrize("use_pp", [False, True])
 def test_sage_eval_matches_dense(use_pp):
     g = synthetic_graph(n_nodes=35, avg_degree=4, n_feat=5, n_class=4, seed=8)

@@ -1,4 +1,10 @@
-"""Pallas ELL bucket kernel vs jnp reference (interpret mode on CPU)."""
+"""Pallas ELL bucket kernel vs jnp reference (interpret mode on CPU).
+
+The kernel is a STUDY ARTIFACT living in tools/pallas_spmm.py (round 5: the
+unrolled column-chain accumulation beat it on hardware and the dispatch was
+retired); its interpreter checks are kept but slow-marked, out of the
+default (tier-1) run. test_ell_accum_modes_agree pins the LIVE ops/ell
+accumulation paths and stays in the default tier."""
 
 import jax.numpy as jnp
 import numpy as np
@@ -8,10 +14,11 @@ from bnsgcn_tpu.data.artifacts import build_artifacts
 from bnsgcn_tpu.data.graph import synthetic_graph
 from bnsgcn_tpu.data.partitioner import partition_graph
 from bnsgcn_tpu.ops.ell import build_layouts
-from bnsgcn_tpu.ops.pallas_spmm import pallas_bucket_sum, pallas_ell_apply
+from tools.pallas_spmm import pallas_bucket_sum, pallas_ell_apply
 from bnsgcn_tpu.ops.spmm import agg_sum
 
 
+@pytest.mark.slow
 def test_bucket_sum_matches_gather():
     rng = np.random.default_rng(0)
     n, h_dim, r, w = 50, 8, 16, 4
@@ -23,6 +30,7 @@ def test_bucket_sum_matches_gather():
     np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pallas_ell_apply_matches_segment():
     g = synthetic_graph(n_nodes=60, avg_degree=6, n_feat=5, seed=2,
                         power_law=True)
@@ -40,10 +48,11 @@ def test_pallas_ell_apply_matches_segment():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_pallas_bucket_reduce_matches_sum():
     rng = np.random.default_rng(5)
     g = jnp.asarray(rng.normal(size=(24, 8, 16)).astype(np.float32))
-    from bnsgcn_tpu.ops.pallas_spmm import pallas_bucket_reduce
+    from tools.pallas_spmm import pallas_bucket_reduce
     out = pallas_bucket_reduce(g, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(g.sum(1)),
                                rtol=1e-5, atol=1e-5)

@@ -125,11 +125,36 @@ COMM_PROBE = r'''
 import numpy as np, jax, jax.numpy as jnp
 from bnsgcn_tpu.parallel.halo import make_halo_spec, wire_bytes
 n_b = np.array([[0, 50000], [48000, 0]])
-for strat in ("padded", "shift"):
+for strat in ("padded", "shift", "ragged"):
     for wire in ("native", "bf16", "fp8", "int8"):
         sp, _ = make_halo_spec(n_b, 0, 50048, 0.1, strategy=strat, wire=wire)
         print(f"{strat}/{wire}: {wire_bytes(sp, 256, 2)/1e6:.2f} MB/exchange",
               flush=True)
+# one real ragged halo_apply on the 1-device mesh: dispatch cost of the
+# NATIVE lax.ragged_all_to_all inside the actual exchange (PR 1)
+import time
+from jax.sharding import PartitionSpec as P
+from bnsgcn_tpu.parallel.halo import halo_apply, make_halo_plan, ragged_native_ok
+from bnsgcn_tpu.parallel.mesh import make_parts_mesh, shard_map
+sp1, tb1 = make_halo_spec(np.array([[4096]]), 8192, 4224, 0.5,
+                          strategy="ragged")
+mesh1 = make_parts_mesh(1)
+bnd = jnp.arange(4224, dtype=jnp.int32)[None, None]
+def one(h, bnd, tb):
+    plan = make_halo_plan(sp1, {k: v for k, v in tb.items()},
+                          bnd[0], jnp.uint32(0), jax.random.key(0))
+    return halo_apply(sp1, plan, h[0])[None]
+f = jax.jit(shard_map(one, mesh=mesh1, in_specs=(P("parts"), P("parts"), P()),
+                      out_specs=P("parts")))
+h = jnp.zeros((1, 8192, 256), jnp.bfloat16)
+tb1 = {k: jnp.asarray(v) for k, v in tb1.items()}
+y = f(h, bnd, tb1); y.block_until_ready()
+t0 = time.perf_counter()
+for _ in range(50):
+    y = f(h, bnd, tb1)
+y.block_until_ready()
+print(f"ragged halo_apply (native={ragged_native_ok()}): "
+      f"{(time.perf_counter()-t0)/50*1e3:.2f} ms/exchange", flush=True)
 print("COMM PROBE OK", flush=True)
 '''
 

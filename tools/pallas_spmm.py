@@ -6,7 +6,9 @@ kernel for `out[r] = sum_w h[idx[r, w]]` over one ELL bucket
 accumulation.
 
 Status: STUDY ARTIFACT (round 5) — correct under the Pallas interpreter
-(tests/test_pallas_spmm.py) but wired into no training path. The unrolled
+(tests/test_pallas_spmm.py, slow tier) but wired into no training path; it
+lives in tools/ (not the importable bnsgcn_tpu package) so the default test
+tier and the training import graph never pay for it. The unrolled
 column-chain accumulation (ops/ell._bucket_sum accum='unroll') beat the
 materializing reduce this kernel fuses by 1.9x on the v5e cap bucket and
 set the 0.573 s/epoch headline, so the `use_pallas` dispatch to

@@ -97,9 +97,14 @@ def main():
                             ("shift", "fp8")]:
             spec, _ = make_halo_spec(n_b, 0, pad_b, args.rate,
                                      strategy=strat, wire=wire)
-            # bytes per epoch per chip: fwd+bwd per hidden exchange
-            variants[(strat, wire)] = (
-                2 * n_ex * wire_bytes(spec, args.hidden, 2))
+            # bytes per epoch per chip: fwd+bwd per hidden exchange.
+            # wire_bytes' padded accounting counts the full P-block buffer
+            # (hw-probe parity); this table models CROSS-CHIP ICI payload,
+            # so drop the chip-local self block
+            wb = wire_bytes(spec, args.hidden, 2)
+            if strat == "padded":
+                wb = wb * (P - 1) // P
+            variants[(strat, wire)] = 2 * n_ex * wb
 
         t_spmm = (e_per * args.ell_waste * args.spmm_passes) / args.ell_rate
         t_comm = variants[("shift", "fp8")] / args.bw_ici

@@ -56,6 +56,23 @@ fresh_ok() {
   fi
 }
 
+# status="partial": a worker measured SOMETHING this run and then failed —
+# fresh for best_known purposes, but the line's remaining candidates were
+# never reached, so the line also goes back in the queue (retry-budgeted).
+partial_run() {
+  grep '"metric"' "$1" 2>/dev/null | tail -1 \
+    | grep -q '"status": *"partial"'
+}
+
+# The queue is appended by humans and by this script; a final line missing
+# its trailing newline would otherwise merge with the next append (and the
+# awk/sed physical-line cursor would silently skip a run).
+ensure_queue_newline() {
+  if [ -s "$QUEUE" ] && [ -n "$(tail -c1 "$QUEUE")" ]; then
+    printf '\n' >> "$QUEUE"
+  fi
+}
+
 alive() {
   timeout 180 python -c \
     "import jax; assert jax.devices() and jax.default_backend() == 'tpu'" \
@@ -157,8 +174,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   fi
   LINE=$(sed -n "$((DONE_N + 1))p" "$QUEUE")
   DONE_N=$((DONE_N + 1))
-  echo "$DONE_N" > "$CURSOR"
-  [ -z "$LINE" ] && continue
+  if [ -z "$LINE" ]; then
+    echo "$DONE_N" > "$CURSOR"
+    continue
+  fi
   wait_alive
   echo "run[$i]: $LINE" >> "$STATUS"
   # shellcheck disable=SC2086
@@ -171,14 +190,29 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     RAN_ANY=1
     REPRO_DONE=0   # new measurements may change best_known; re-arm the repro
     REPRO_TRIES=0
+    if partial_run "$LOGDIR/r5w5_${STAMP}_q$i.log" \
+       && [ "$RETRY_BUDGET" -gt 0 ]; then
+      # partial = measured-then-died: the rest of the line's candidates
+      # still deserve their window
+      RETRY_BUDGET=$((RETRY_BUDGET - 1))
+      ensure_queue_newline
+      printf '%s\n' "$LINE" >> "$QUEUE"
+      echo "run[$i] partial; requeued (retry budget $RETRY_BUDGET)" >> "$STATUS"
+    fi
   elif [ "$RETRY_BUDGET" -gt 0 ]; then
     # no fresh measurement (tunnel died mid-run, or a compile crash the
     # preflight could not see): give the line another shot at the back of
     # the queue rather than silently losing its candidates for the session
     RETRY_BUDGET=$((RETRY_BUDGET - 1))
+    ensure_queue_newline
     printf '%s\n' "$LINE" >> "$QUEUE"
     echo "run[$i] requeued (retry budget $RETRY_BUDGET)" >> "$STATUS"
   fi
+  # Persist the cursor only AFTER the fresh/requeue decision: a
+  # kill-and-relaunch mid-run used to advance past the in-flight line and
+  # silently drop it; now the relaunch replays it instead (bench runs are
+  # idempotent — best_known only improves).
+  echo "$DONE_N" > "$CURSOR"
   i=$((i + 1))
 done
 echo "DONE" >> "$STATUS"
