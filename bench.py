@@ -187,16 +187,24 @@ def _vhalo(v):
     return v[5] if len(v) > 5 else "padded"
 
 
+def _vovl(v):
+    """Overlap mode of a variant tuple (7th field, PR 2's interior/frontier
+    split aggregation); shorter tuples mean 'off' — pre-existing names and
+    queue lines stay valid."""
+    return v[6] if len(v) > 6 else "off"
+
+
 def _vname(v):
     """Candidate display/CLI name for a (spmm, use_pallas, gather_dtype,
-    dense_dtype, tile[, halo]) variant tuple — the vocabulary --candidates
-    and .watch_queue lines are written in (unit-pinned so a rename can never
-    silently invalidate a queued tunnel-window run)."""
+    dense_dtype, tile[, halo[, overlap]]) variant tuple — the vocabulary
+    --candidates and .watch_queue lines are written in (unit-pinned so a
+    rename can never silently invalidate a queued tunnel-window run)."""
     return (v[0] + ("+pallas" if v[1] else "")
             + ({"fp8": "+f8g", "int8": "+i8g"}.get(v[2], ""))
             + ("+i8d" if v[3] == "int8" else "")
             + (f"+t{v[4]}" if v[4] != 512 else "")
-            + ({"ragged": "+rag", "shift": "+shift"}.get(_vhalo(v), "")))
+            + ({"ragged": "+rag", "shift": "+shift"}.get(_vhalo(v), ""))
+            + ("+ovl" if _vovl(v) == "split" else ""))
 
 
 def _emit_result_line(args, value, status=None, measured_at=None, spmm=None,
@@ -454,7 +462,10 @@ def main():
                          "ell+i8g, ell+f8g, hybrid+pallas, hybrid+pallas+i8g; "
                          "a +rag suffix runs the same recipe under the "
                          "exact-bytes ragged halo exchange: hybrid+rag, "
-                         "ell+rag, hybrid+pallas+rag)"
+                         "ell+rag, hybrid+pallas+rag; a +ovl suffix runs it "
+                         "with --overlap split interior/frontier "
+                         "aggregation: hybrid+ovl, ell+ovl, "
+                         "hybrid+pallas+ovl, hybrid+pallas+rag+ovl)"
                          " — for short TPU-tunnel windows. The pallas names "
                          "only exist on a TPU backend without --no-pallas; "
                          "an all-unknown list is an error (exit 2), never a "
@@ -552,7 +563,15 @@ def main():
         # single bench chip this measures the ragged collective's dispatch
         # cost inside the real train step (cross-chip bytes need a pod);
         # ragged_all_to_all itself is v5e-validated (hw_session_r4.log)
-        universe += [("hybrid", True, "native", "native", 512, "ragged")]
+        universe += [("hybrid", True, "native", "native", 512, "ragged"),
+                     # interior/frontier split aggregation (--overlap split):
+                     # a single bench chip measures the split-layout overhead
+                     # (P=1 has zero frontier rows); the latency hiding
+                     # itself needs a multi-chip window
+                     ("hybrid", True, "native", "native", 512, "padded",
+                      "split"),
+                     ("hybrid", True, "native", "native", 512, "ragged",
+                      "split")]
     universe += [("hybrid", False, "native", "native", 512),
                  ("hybrid", False, "native", "native", 256),
                  ("hybrid", False, "native", "int8", 512),
@@ -562,7 +581,12 @@ def main():
                  ("ell", False, "int8", "native", 512),
                  ("ell", False, "fp8", "native", 512),
                  ("hybrid", False, "native", "native", 512, "ragged"),
-                 ("ell", False, "native", "native", 512, "ragged")]
+                 ("ell", False, "native", "native", 512, "ragged"),
+                 ("hybrid", False, "native", "native", 512, "padded",
+                  "split"),
+                 ("hybrid", False, "native", "native", 512, "ragged",
+                  "split"),
+                 ("ell", False, "native", "native", 512, "padded", "split")]
     anchor = ("ell", False, "native", "native", 512)
     if args.spmm == "hybrid":
         candidates = [anchor] + universe
@@ -629,6 +653,7 @@ def main():
         spmm, use_pallas, gather, dense, tile = variant[:5]
         return Config(model=args.model,
                       halo_exchange=_vhalo(variant),
+                      overlap=_vovl(variant),
                       heads=2 if args.model == "gat" else 1,
                       n_layers=args.layers,
                       n_hidden=args.hidden, use_pp=True, dropout=0.5,
@@ -756,23 +781,30 @@ def main():
     # own file and survive occupancy/budget/tile sweeps; each hybrid
     # tiling geometry gets its own file (multi-GB stacks — one file per
     # key avoids rewriting every stack when one is added).
-    from bnsgcn_tpu.trainer import hybrid_layout_key, hybrid_tiling
+    from bnsgcn_tpu.trainer import (ell_layout_key, hybrid_layout_key,
+                                    hybrid_tiling)
 
     def variant_key(variant):
-        return ("ell" if variant[0] != "hybrid"
+        return (ell_layout_key(make_cfg(variant))
+                if variant[0] != "hybrid"
                 else hybrid_layout_key(make_cfg(variant)))
 
     def hyb_path_for(variant):
         occ, tile, budget = hybrid_tiling(make_cfg(variant))
         suf = f"_t{tile}" if tile != 512 else ""
+        if _vovl(variant) == "split":
+            suf += "_ovl"          # interior/frontier pair: own multi-GB file
         return os.path.join(
             args.cache_dir, f"layouts_hyb_{tag}_{occ}_{budget}{suf}.pkl")
 
     hyb_variants = {variant_key(v): v for v in candidates
                     if v[0] == "hybrid"}
     ell_path = os.path.join(args.cache_dir, f"layouts_ell_{tag}.pkl")
+    ell_ovl_path = os.path.join(args.cache_dir, f"layouts_ell_ovl_{tag}.pkl")
     gat_path = os.path.join(args.cache_dir, f"layouts_gat_{tag}.pkl")
     layout_cache = _try_load(ell_path, log) or {}
+    if any(variant_key(v) == "ell:ovl" for v in candidates):
+        layout_cache.update(_try_load(ell_ovl_path, log) or {})
     if args.model == "gat":
         layout_cache.update(_try_load(gat_path, log) or {})
     for v in hyb_variants.values():
@@ -785,6 +817,7 @@ def main():
         nonlocal lc_keys0
         for key in set(layout_cache) - lc_keys0:
             path = (ell_path if key == "ell"
+                    else ell_ovl_path if key == "ell:ovl"
                     else gat_path if key == "gat"
                     else hyb_path_for(hyb_variants[key]))
             _atomic_dump({key: layout_cache[key]}, path)

@@ -106,6 +106,13 @@ class Config:
                                         #  hop-count tiebreak; logged at startup)
     halo_wire: str = "native"           # interconnect payload dtype for the training halo
                                         # exchange: 'native' | 'bf16' | 'fp8' (e4m3 + scales)
+    overlap: str = "off"                # 'off' (fused exchange-then-aggregate; the
+                                        # historical step graph) | 'split' (interior/
+                                        # frontier row-split aggregation: the halo
+                                        # collective is dispatched first and the
+                                        # interior SpMM — rows with no halo
+                                        # in-neighbor — runs while it is in flight;
+                                        # numerically row-exact vs 'off')
     streaming_artifacts: str = "auto"   # 'auto' (> 30M edges) | 'always' | 'never':
                                         # build partition artifacts one part at a time
     feat_storage: str = "float32"       # on-disk feature dtype for streamed artifacts
@@ -195,6 +202,7 @@ def create_parser() -> argparse.ArgumentParser:
     both("halo-exchange", type=str, default="padded",
          choices=["padded", "shift", "ragged", "auto"])
     both("halo-wire", type=str, default="native", choices=["native", "bf16", "fp8", "int8"])
+    p.add_argument("--overlap", type=str, default="off", choices=["off", "split"])
     both("streaming-artifacts", type=str, default="auto",
          choices=["auto", "always", "never"])
     both("feat-storage", type=str, default="float32",

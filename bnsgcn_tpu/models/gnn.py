@@ -100,6 +100,12 @@ class GraphEnv:
     # (GatEllSpec, arrays dict): dense per-row GAT attention over the ELL
     # layout (ops/ell_attention.py) when set; segment softmax otherwise
     remat: bool = False                # jax.checkpoint each layer (HBM for FLOPs+comm)
+    agg_exchange: Optional[Callable] = None
+    # agg_exchange(layer, h [n_dst, d], scale_out_norm) -> [n_dst, d]:
+    # fused exchange + sum-aggregation override (--overlap split re-threads
+    # the layer body as start-exchange -> interior-agg -> finish-exchange ->
+    # frontier-agg -> merge through this seam). None = the historical
+    # exchange-then-aggregate path.
 
 
 def env_agg_sum(env: "GraphEnv", h_ext: jax.Array) -> jax.Array:
@@ -107,6 +113,23 @@ def env_agg_sum(env: "GraphEnv", h_ext: jax.Array) -> jax.Array:
     if env.aggregate is not None:
         return env.aggregate(h_ext)
     return agg_sum(h_ext, env.src, env.dst, env.n_dst, env.edge_chunk)
+
+
+def env_agg_exchange(env: "GraphEnv", i: int, h: jax.Array,
+                     scale_out_norm: bool = False) -> jax.Array:
+    """One layer's exchange + sum-aggregation: h [n_dst, d] -> [n_dst, d].
+
+    `scale_out_norm` divides the extended rows by env.out_norm BEFORE
+    aggregating (the GCN symmetric norm, module/layer.py:26-46). Default
+    path is the historical fused exchange-then-aggregate, op for op; when
+    `env.agg_exchange` is set (--overlap split), it runs the interior/
+    frontier split so the collective overlaps interior compute."""
+    if env.agg_exchange is not None:
+        return env.agg_exchange(i, h, scale_out_norm)
+    h_ext, _ = env.exchange(i, h)
+    if scale_out_norm:
+        h_ext = (h_ext / env.out_norm[:, None]).astype(h_ext.dtype)
+    return env_agg_sum(env, h_ext)
 
 
 # ----------------------------------------------------------------------------
@@ -228,21 +251,22 @@ def _linear(p, h):
     return h @ p["w"] + p["b"]
 
 
-def _gcn_layer(p, h_ext, env: GraphEnv):
+def _gcn_layer(p, i, h, env: GraphEnv):
     """Symmetric-norm SpMM then linear (module/layer.py:26-46).
 
     Degree norms are f32; divisions happen in f32 but the result is cast back
     to the activation dtype so the (bytes-bound) gather stays bf16 in bf16 runs.
+    The exchange rides inside env_agg_exchange so --overlap split can run the
+    collective concurrently with the interior rows' aggregation.
     """
-    h = (h_ext / env.out_norm[:, None]).astype(h_ext.dtype)
-    s = env_agg_sum(env, h)
-    return _linear(p, (s / env.in_norm[:, None]).astype(h_ext.dtype))
+    s = env_agg_exchange(env, i, h, scale_out_norm=True)
+    return _linear(p, (s / env.in_norm[:, None]).astype(h.dtype))
 
 
-def _sage_layer(p, h_dst, h_ext, env: GraphEnv):
+def _sage_layer(p, i, h, env: GraphEnv):
     """linear1(self) + linear2(sum(nbrs)/in_deg) (module/layer.py:79-92)."""
-    ah = (env_agg_sum(env, h_ext) / env.in_norm[:, None]).astype(h_ext.dtype)
-    return _linear(p["linear1"], h_dst) + _linear(p["linear2"], ah)
+    ah = (env_agg_exchange(env, i, h) / env.in_norm[:, None]).astype(h.dtype)
+    return _linear(p["linear1"], h[:env.n_dst]) + _linear(p["linear2"], ah)
 
 
 def _gat_layer(p, h_dst, h_ext, presence, env: GraphEnv, heads, out_feats,
@@ -338,16 +362,14 @@ def _layer_forward(h, *, i, params, state, spec: ModelSpec, env: GraphEnv, rng):
         elif env.training and spec.use_pp and i == 0:
             # precomputed layer 0: pure dense matmul (module/layer.py:29-30,83-84)
             h = _linear(p, h)
+        elif spec.model == "gcn":
+            h = _gcn_layer(p, i, h, env)
+        elif (not env.training) and spec.use_pp and i == 0:
+            # eval pp layer 0: cat(feat, mean) @ W  (module/layer.py:99-100)
+            ah = env_agg_exchange(env, i, h) / env.in_norm[:, None]
+            h = _linear(p, jnp.concatenate([h[:env.n_dst], ah], 1))
         else:
-            h_ext, _ = env.exchange(i, h)
-            if spec.model == "gcn":
-                h = _gcn_layer(p, h_ext, env)
-            elif (not env.training) and spec.use_pp and i == 0:
-                # eval pp layer 0: cat(feat, mean) @ W  (module/layer.py:99-100)
-                ah = env_agg_sum(env, h_ext) / env.in_norm[:, None]
-                h = _linear(p, jnp.concatenate([h[:env.n_dst], ah], 1))
-            else:
-                h = _sage_layer(p, h[:env.n_dst], h_ext, env)
+            h = _sage_layer(p, i, h, env)
     elif spec.model == "gat":
         out_feats = spec.layer_sizes[i + 1]
         if is_graph_layer:

@@ -24,6 +24,8 @@ and ride through shard_map as ordinary sharded int arrays.
 
 from __future__ import annotations
 
+import os
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from functools import partial
 from typing import Sequence
@@ -31,6 +33,31 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def build_workers(n_tasks: int, cap: int = 8) -> int:
+    """Host-parallelism width for offline layout builds (ROADMAP open item:
+    the hybrid build was ~980 s of single-threaded numpy at bench scale).
+    The heavy kernels (sorts, bincounts, fancy indexing) run per part /
+    per direction in a ThreadPoolExecutor — no pickling of the multi-GB
+    inputs. BNSGCN_BUILD_WORKERS=1 restores strictly serial builds (or any
+    explicit width caps the pool)."""
+    env = os.environ.get("BNSGCN_BUILD_WORKERS")
+    if env:
+        return max(1, min(int(env), max(n_tasks, 1)))
+    return max(1, min(cap, os.cpu_count() or 1, max(n_tasks, 1)))
+
+
+def run_parallel(fns):
+    """Run thunks via ThreadPoolExecutor (results in order); serial when the
+    worker budget is 1 so BNSGCN_BUILD_WORKERS=1 gives bit-identical
+    single-threaded behavior."""
+    w = build_workers(len(fns))
+    if w <= 1 or len(fns) <= 1:
+        return [f() for f in fns]
+    with ThreadPoolExecutor(max_workers=w) as ex:
+        futs = [ex.submit(f) for f in fns]
+        return [f.result() for f in futs]
 
 
 ELL_SPLIT_CAP = 128   # rows with degree > cap are split into cap-wide chunks
@@ -260,25 +287,26 @@ def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
         rows_max = tuple(g["rows"])
         split_max, chunk_max, eff_cap = g["split"], g["chunks"], g["cap"]
 
-        idx_stacked = [[] for _ in widths]
-        perms, cpos, csegs = [], [], []
-        for p in range(P):
+        def build_one(p):
             s, d = _part_edges(src_all[p], dst_all[p], n_dst, direction)
             _, _, idx, perm, cp, cs, _ = build_ell_numpy(
                 s, d, n_rows, n_src, widths=widths, row_pad=rows_max,
                 cap=eff_cap, split_pad=split_max, chunk_pad=chunk_max)
-            for k in range(len(widths)):
-                idx_stacked[k].append(idx[k])
-            perms.append(perm)
-            cpos.append(cp)
-            csegs.append(cs)
+            return idx, perm, cp, cs
+
+        results = run_parallel([partial(build_one, p) for p in range(P)])
+        idx_stacked = [[r[0][k] for r in results] for k in range(len(widths))]
+        perms = [r[1] for r in results]
+        cpos = [r[2] for r in results]
+        csegs = [r[3] for r in results]
         spec = EllSpec(widths=widths, rows=rows_max, n_rows=n_rows,
                        n_src=n_src, n_split=split_max, n_chunks=chunk_max)
         return (spec, [np.stack(x) for x in idx_stacked], np.stack(perms),
                 np.stack(cpos), np.stack(csegs))
 
-    fwd_spec, fwd_idx, fwd_perm, fwd_cp, fwd_cs = build_all("fwd")
-    bwd_spec, bwd_idx, bwd_perm, bwd_cp, bwd_cs = build_all("bwd")
+    (fwd_spec, fwd_idx, fwd_perm, fwd_cp, fwd_cs), \
+        (bwd_spec, bwd_idx, bwd_perm, bwd_cp, bwd_cs) = run_parallel(
+            [partial(build_all, "fwd"), partial(build_all, "bwd")])
     arrays = {"fwd_perm": fwd_perm, "bwd_perm": bwd_perm}
     if fwd_spec.n_split:
         arrays["fwd_chunk_pos"], arrays["fwd_chunk_seg"] = fwd_cp, fwd_cs
@@ -289,6 +317,43 @@ def build_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
     for k in range(len(bwd_spec.widths)):
         arrays[f"bwd_idx_{k}"] = bwd_idx[k]
     return fwd_spec, bwd_spec, arrays
+
+
+def build_split_layouts(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int,
+                        n_src_ext: int, cap: int = ELL_SPLIT_CAP):
+    """Interior/frontier row-partitioned ELL layouts (--overlap split).
+
+    Each part's destination rows are split by ops/spmm.frontier_mask and
+    remapped to two compact row spaces (compact ids ascend with original
+    id), so one layer's aggregation becomes
+
+        interior_spmm(h)             # gathers ONLY owned rows — no halo dep
+        frontier_spmm([h ; halo])    # rows that need the exchange
+        out = concat(int_out, fro_out)[merge_perm]
+
+    with `merge_perm` the recombination permutation back to original row
+    order. Row-exact vs the fused layout: every output row's complete edge
+    set lands on exactly one side (a frontier row's LOCAL in-edges aggregate
+    on the frontier side with it). Degree-0/padded rows are interior.
+
+    The interior pair gathers from the owned space (n_src = n_dst), so its
+    backward emits d_h directly; the frontier pair gathers from the full
+    extended space and its backward emits d_h_ext (the halo slice of which
+    transposes through the backward exchange).
+
+    Returns ((int_fwd, int_bwd), (fro_fwd, fro_bwd), arrays, n_int_pad,
+    n_fro_pad); arrays = 'int_*'/'fro_*'-prefixed build_layouts tables plus
+    'merge_perm' [P, n_dst] int32."""
+    from bnsgcn_tpu.ops.spmm import split_row_partition
+    _, merge_perm, (si, di, n_int_pad), (sf, df, n_fro_pad) = \
+        split_row_partition(src_all, dst_all, n_dst)
+    (int_f, int_b, int_arr), (fro_f, fro_b, fro_arr) = run_parallel([
+        partial(build_layouts, si, di, n_int_pad, n_dst, cap=cap),
+        partial(build_layouts, sf, df, n_fro_pad, n_src_ext, cap=cap)])
+    arrays = {"merge_perm": merge_perm}
+    arrays.update({f"int_{k}": v for k, v in int_arr.items()})
+    arrays.update({f"fro_{k}": v for k, v in fro_arr.items()})
+    return (int_f, int_b), (fro_f, fro_b), arrays, n_int_pad, n_fro_pad
 
 
 def _bucket_sum(hp, idx, w, chunk_gathers: int = 4_000_000,

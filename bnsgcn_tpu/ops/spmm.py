@@ -16,6 +16,127 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+# ----------------------------------------------------------------------------
+# interior / frontier row split (offline numpy) — the --overlap split
+# foundation shared by every SpMM layout family. A destination row is
+# FRONTIER when at least one of its in-edges arrives from a halo slot
+# (src >= n_dst in the extended index space) and INTERIOR otherwise; an
+# interior row's whole aggregation is independent of the halo exchange, so
+# the per-layer collective can run concurrently with it (DistGNN's
+# local/remote-aggregate overlap, arXiv:2104.06700).
+# ----------------------------------------------------------------------------
+
+def frontier_mask(src: np.ndarray, dst: np.ndarray, n_dst: int) -> np.ndarray:
+    """[n_dst] bool: rows with >= 1 in-edge from a halo slot. Computed from
+    the FULL static edge list — BNS sampling only zeroes halo values, never
+    removes edges, so the split is epoch-invariant."""
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    m = np.zeros(n_dst, dtype=bool)
+    halo = (dst < n_dst) & (src >= n_dst)
+    m[dst[halo]] = True
+    return m
+
+
+def _pad8(n: int) -> int:
+    return max(8, ((n + 7) // 8) * 8)
+
+
+def _classify_edges(s: np.ndarray, d: np.ndarray, fm: np.ndarray,
+                    n_dst: int):
+    """(interior_edge_mask, frontier_edge_mask) for one part's padded COO
+    edges under frontier row mask `fm` (trash edges d == n_dst in neither)."""
+    fmx = np.append(fm, False)
+    real = d < n_dst
+    is_f = real & fmx[d]
+    return real & ~fmx[d], is_f
+
+
+def _pack_edge_sets(sets, trash: int):
+    """Stack per-part (src, dst) edge lists to [P, E_pad] int32 with the
+    trash convention dst == `trash`, src == 0 — the one padding
+    implementation every split family shares."""
+    P = len(sets)
+    e_max = _pad8(max((len(s) for s, _ in sets), default=0))
+    sa = np.zeros((P, e_max), dtype=np.int32)
+    da = np.full((P, e_max), trash, dtype=np.int32)
+    for p, (s, d) in enumerate(sets):
+        sa[p, :len(s)] = s
+        da[p, :len(d)] = d
+    return sa, da
+
+
+def split_row_partition(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int):
+    """The shared interior/frontier row split consumed by every split layout
+    family (ops/ell.build_split_layouts, ops/block_spmm
+    .build_split_block_layouts) — one implementation so the compact-id,
+    padding and merge conventions cannot drift between them.
+
+    Per part, destination rows are remapped to two compact row spaces
+    (compact ids ascend with original id; degree-0/padded rows are
+    interior). Returns (masks, merge_perm, (src_int, dst_int, n_int_pad),
+    (src_fro, dst_fro, n_fro_pad)):
+
+      * masks: per-part frontier bool [n_dst] arrays;
+      * merge_perm [P, n_dst] int32: out[r] = concat(int_out [n_int_pad],
+        fro_out [n_fro_pad])[merge_perm[r]] — the recombination back to
+        original row order;
+      * edge arrays [P, E_pad] int32 in the compact row spaces, padded to a
+        common length with the trash convention dst == n_X_pad, src == 0.
+        Both row spaces are floored at 8 rows so degenerate parts (zero
+        interior or zero frontier anywhere) build ordinary all-padded
+        tables instead of zero-size special cases.
+    """
+    P = src_all.shape[0]
+    masks = [frontier_mask(src_all[p], dst_all[p], n_dst) for p in range(P)]
+    n_int_pad = _pad8(max(int((~m).sum()) for m in masks))
+    n_fro_pad = _pad8(max(int(m.sum()) for m in masks))
+    merge_perm = np.zeros((P, n_dst), dtype=np.int32)
+    e_int, e_fro = [], []
+    for p in range(P):
+        fm = masks[p]
+        int_id = (np.cumsum(~fm) - 1).astype(np.int64)
+        fro_id = (np.cumsum(fm) - 1).astype(np.int64)
+        merge_perm[p] = np.where(fm, n_int_pad + fro_id, int_id)
+        s = np.asarray(src_all[p])
+        d = np.asarray(dst_all[p])
+        is_i, is_f = _classify_edges(s, d, fm, n_dst)
+        e_int.append((s[is_i], int_id[d[is_i]]))
+        e_fro.append((s[is_f], fro_id[d[is_f]]))
+    si, di = _pack_edge_sets(e_int, n_int_pad)
+    sf, df = _pack_edge_sets(e_fro, n_fro_pad)
+    return (masks, merge_perm, (si, di, n_int_pad), (sf, df, n_fro_pad))
+
+
+def split_coo(src_all: np.ndarray, dst_all: np.ndarray, n_dst: int
+              ) -> dict[str, np.ndarray]:
+    """Row-partition each part's COO edges into the interior set (edges whose
+    dst row has no halo in-neighbor — all such edges have src < n_dst) and
+    the frontier set (ALL edges of rows with >= 1 halo in-neighbor, local
+    sources included). Padded per set to a common length across parts with
+    the usual trash convention (dst == n_dst, src == 0).
+
+    Returns {'seg_int_src','seg_int_dst','seg_fro_src','seg_fro_dst'}
+    stacked [P, E_pad]. Because the two sets cover disjoint OUTPUT rows, the
+    recombination is an exact elementwise add of the two aggregations (dst
+    ids stay in the ORIGINAL row space — no compaction, no merge perm)."""
+    P = src_all.shape[0]
+    ints, fros = [], []
+    for p in range(P):
+        s = np.asarray(src_all[p])
+        d = np.asarray(dst_all[p])
+        is_i, is_f = _classify_edges(s, d, frontier_mask(s, d, n_dst), n_dst)
+        ints.append((s[is_i], d[is_i]))
+        fros.append((s[is_f], d[is_f]))
+    out = {}
+    for name, sets in (("int", ints), ("fro", fros)):
+        sa, da = _pack_edge_sets(sets, n_dst)
+        out[f"seg_{name}_src"] = sa
+        out[f"seg_{name}_dst"] = da
+    return out
 
 
 def gather_scatter_sum(h_src: jax.Array, src: jax.Array, dst: jax.Array,

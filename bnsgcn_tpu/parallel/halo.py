@@ -476,24 +476,30 @@ def _ragged_wire_bwd(spec, sizes, _, g):
 _ragged_wire.defvjp(_ragged_wire_fwd, _ragged_wire_bwd)
 
 
-def halo_apply(spec: HaloSpec, plan: HaloPlan, h: jax.Array) -> jax.Array:
-    """One layer's halo exchange: h [pad_inner, d] -> h_ext [pad_inner + n_halo, d].
+def halo_start(spec: HaloSpec, plan: HaloPlan, h: jax.Array):
+    """Dispatch one layer's halo exchange WITHOUT consuming its result.
 
-    Fully differentiable; the AD transpose is the reference's backward
-    all-to-all with scatter-add x (1/ratio) (helper/feature_buffer.py:119-129).
-    The wire codec hops carry custom VJPs so fp8/bf16 compression applies to
-    both directions with direction-appropriate scales.
+    Returns the in-flight received payload (a pytree of arrays: one
+    [P*S_pad, d] buffer for 'padded'/'ragged', a tuple of per-round blocks
+    for 'shift') to be scattered into halo slots by `halo_finish`. Nothing
+    here depends on any aggregation output, and nothing downstream of the
+    caller's independent (interior) compute depends on this value — that
+    dependence gap is what lets the XLA latency-hiding scheduler run the
+    collective concurrently with interior SpMM work (`--overlap split`).
+
+    Composes with all three strategies and all four wire codecs; AD through
+    start+finish is exactly halo_apply's transpose (the custom-vjp wire hops
+    sit inside), so gradients re-quantize with their own scales as before.
     """
     P, Sp, d = spec.n_parts, spec.pad_send, h.shape[-1]
     if spec.strategy == "shift" and P > 1:
         me = jax.lax.axis_index(spec.axis_name)
-        buf = jnp.zeros((spec.n_halo + 1, d), dtype=h.dtype)
+        recvs = []
         for k in range(1, P):
             Sk = spec.shift_pads[k - 1]
             if Sk == 0:
                 continue                       # no pair on this diagonal sends
             to = (me + k) % P                  # peer I send to this round
-            frm = (me - k) % P                 # peer I receive from
             sel_k = jax.lax.dynamic_index_in_dim(plan.sel, to, 0, False)[:Sk]
             w_k = jax.lax.dynamic_index_in_dim(plan.weight, to, 0, False)[:Sk]
             send = (h[sel_k] * w_k[:, None]).astype(h.dtype)       # [Sk, d]
@@ -502,34 +508,65 @@ def halo_apply(spec: HaloSpec, plan: HaloPlan, h: jax.Array) -> jax.Array:
                 recv = jax.lax.ppermute(send, spec.axis_name, perm)
             else:
                 recv = _ppermute_wire(spec, k, send)
-            slots_k = jax.lax.dynamic_index_in_dim(plan.slots, frm, 0, False)[:Sk]
-            buf = buf.at[slots_k].add(recv)
-        return jnp.concatenate([h, buf[:-1]], axis=0)
+            recvs.append(recv)
+        return tuple(recvs)
 
+    # keep the payload in h's dtype: weight is f32, and bf16*f32 would promote
+    # (doubling the wire bytes and tripping the bf16 scatter in halo_finish)
+    send = (h[plan.sel] * plan.weight[..., None]).astype(h.dtype)  # [P, S, d]
     if spec.strategy == "ragged":
         # exact per-pair rows in ONE collective (runs even at P=1 so a
         # single-chip bench measures the real dispatch cost); the valid
         # sample rows are the FIRST send_size[me, j] of each S_pad block
         # (sampling.pair_sample contract), which is what makes the ragged
         # chunks contiguous prefixes
-        send = (h[plan.sel] * plan.weight[..., None]).astype(h.dtype)
-        recv = _ragged_wire(spec, spec.pair_send, send).reshape(P * Sp, d)
-        buf = jnp.zeros((spec.n_halo + 1, d), dtype=h.dtype)
-        buf = buf.at[plan.slots.reshape(-1)].add(recv)
-        return jnp.concatenate([h, buf[:-1]], axis=0)
-
-    # padded: one tiled all_to_all, uniform S_pad per pair.
-    # keep the payload in h's dtype: weight is f32, and bf16*f32 would promote
-    # (doubling the wire bytes and tripping the bf16 scatter below)
-    send = (h[plan.sel] * plan.weight[..., None]).astype(h.dtype)  # [P, S, d]
+        return _ragged_wire(spec, spec.pair_send, send).reshape(P * Sp, d)
+    # padded: one tiled all_to_all, uniform S_pad per pair
     if spec.wire == "native":
-        recv = jax.lax.all_to_all(send.reshape(P * Sp, d), spec.axis_name,
+        return jax.lax.all_to_all(send.reshape(P * Sp, d), spec.axis_name,
                                   0, 0, tiled=True)             # [P*S, d]
-    else:
-        recv = _a2a_wire(spec, send).reshape(P * Sp, d)
-    buf = jnp.zeros((spec.n_halo + 1, d), dtype=h.dtype)
+    return _a2a_wire(spec, send).reshape(P * Sp, d)
+
+
+def halo_finish(spec: HaloSpec, plan: HaloPlan, recv, like: jax.Array
+                ) -> jax.Array:
+    """Scatter `halo_start`'s received payload into the fixed per-peer halo
+    slot blocks. Returns the halo buffer [n_halo, d] (NOT concatenated with
+    the inner rows — the overlap-split caller scales/concatenates itself).
+    `like` supplies only the static feature width and dtype; no data
+    dependency on it is introduced."""
+    P = spec.n_parts
+    buf = jnp.zeros((spec.n_halo + 1, like.shape[-1]), dtype=like.dtype)
+    if spec.strategy == "shift" and P > 1:
+        me = jax.lax.axis_index(spec.axis_name)
+        i = 0
+        for k in range(1, P):
+            Sk = spec.shift_pads[k - 1]
+            if Sk == 0:
+                continue                       # matches halo_start's rounds
+            frm = (me - k) % P                 # peer I receive from
+            slots_k = jax.lax.dynamic_index_in_dim(plan.slots, frm, 0, False)[:Sk]
+            buf = buf.at[slots_k].add(recv[i])
+            i += 1
+        return buf[:-1]
     buf = buf.at[plan.slots.reshape(-1)].add(recv)
-    return jnp.concatenate([h, buf[:-1]], axis=0)
+    return buf[:-1]
+
+
+def halo_apply(spec: HaloSpec, plan: HaloPlan, h: jax.Array) -> jax.Array:
+    """One layer's halo exchange: h [pad_inner, d] -> h_ext [pad_inner + n_halo, d].
+
+    Fully differentiable; the AD transpose is the reference's backward
+    all-to-all with scatter-add x (1/ratio) (helper/feature_buffer.py:119-129).
+    The wire codec hops carry custom VJPs so fp8/bf16 compression applies to
+    both directions with direction-appropriate scales.
+
+    Implemented as halo_start + halo_finish (the `--overlap split` seam) so
+    the fused and split paths share one collective implementation and cannot
+    drift numerically.
+    """
+    recv = halo_start(spec, plan, h)
+    return jnp.concatenate([h, halo_finish(spec, plan, recv, h)], axis=0)
 
 
 def sampled_presence(spec: HaloSpec, plan: HaloPlan) -> jax.Array:

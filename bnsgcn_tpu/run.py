@@ -92,6 +92,9 @@ class RunResult:
     final_loss: float = 0.0
     losses: list = field(default_factory=list)
     memory: str = ""
+    overlap_buckets: dict = field(default_factory=dict)
+    # --overlap split: trace-derived per-step exchange/interior/frontier/
+    # hidden ms means (EpochTimer.bucket_means); empty for fused runs
 
 
 def run_training(cfg: Config, g: Optional[Graph] = None,
@@ -170,7 +173,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     if cfg.cache_dir:
         import hashlib
 
-        from bnsgcn_tpu.trainer import hybrid_layout_key
+        from bnsgcn_tpu.trainer import ell_layout_key, hybrid_layout_key
         from bnsgcn_tpu.utils.diskcache import atomic_dump, try_load
         os.makedirs(cfg.cache_dir, exist_ok=True)
         gname = cfg.graph_name or cfg.derive_graph_name()
@@ -180,7 +183,9 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         # rows must never read each other's files
         dg = hashlib.sha1()
         for a in (art.n_b, art.src, art.dst):
-            dg.update(np.ascontiguousarray(a).tobytes())
+            # buffer protocol, not .tobytes(): no transient copy of the
+            # (papers100M-scale: multi-GB) edge arrays just to hash them
+            dg.update(np.ascontiguousarray(a))
         digest = dg.hexdigest()[:12]
 
         def _lc_path(key):
@@ -188,8 +193,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 cfg.cache_dir,
                 f"layouts_{gname}_{digest}_{key.replace(':', '-')}.pkl")
 
+        # preload both the fused and (under --overlap split) the ':ovl'
+        # split-layout namespaces — build_step_fns may fall back to off,
+        # and a downgraded run must still find its fused tables
+        keys = {"ell", "gat", hybrid_layout_key(cfg.replace(overlap="off"))}
+        if cfg.overlap == "split":
+            keys |= {ell_layout_key(cfg), hybrid_layout_key(cfg)}
         layout_cache, lc_loaded = {}, {}
-        for key in ("ell", "gat", hybrid_layout_key(cfg)):
+        for key in sorted(keys):
             obj = try_load(_lc_path(key), log)
             if obj is not None:
                 layout_cache[key] = obj
@@ -224,9 +235,15 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     nb = 2 if cfg.dtype == "bfloat16" else 4
     # Comm column context: the halo label is the RESOLVED strategy (under
     # --halo-exchange auto the pick was logged by build_step_fns; 'auto->'
-    # here keeps the per-run record self-describing)
+    # here keeps the per-run record self-describing). --overlap split tags
+    # the label '+ovl' the same way; the EXCHANGE itself is unchanged by the
+    # split (same spec, same per-layer bytes, still one forward + one
+    # backward hop per layer), so wire_bytes below is reported exactly once
+    # — the interior/frontier split must never double-count it.
     halo_label = (f"auto->{hspec.strategy}"
                   if cfg.halo_exchange == "auto" else hspec.strategy)
+    if fns.overlap == "split":
+        halo_label += "+ovl"
     log(f"Mesh: {cfg.n_partitions} parts | pad_inner={art.pad_inner} "
         f"pad_boundary={art.pad_boundary} pad_send={hspec.pad_send} "
         f"edges/part={art.pad_edges} | halo {halo_label}/{hspec.wire}: "
@@ -453,7 +470,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             tracing = False
             if cfg.profile_dir:
                 log(f"profiler trace written to {cfg.profile_dir}")
-            parsed = traceparse.step_comm_per_epoch(trace_dir)
+            # load the trace ONCE; both the Comm/Reduce attribution and the
+            # overlap report parse the same event list
+            try:
+                trace_events, _ = traceparse.load_trace_events(trace_dir)
+            except Exception:
+                trace_events = None
+            parsed = (traceparse.step_comm_from_events(trace_events)
+                      if trace_events is not None else None)
             if parsed is not None:
                 comm_traced, reduce_traced = parsed[0], parsed[1]
                 # drop the microbench samples recorded so far so the
@@ -465,6 +489,30 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 timer.reduce_dur.clear()
                 timer.comm_dur.append(comm_traced)
                 timer.reduce_dur.append(reduce_traced)
+            if fns.overlap == "split":
+                # --overlap split observability: per-step phase buckets +
+                # whether the collective actually ran under interior compute
+                try:
+                    rep = (traceparse.overlap_from_events(trace_events)
+                           if trace_events is not None else None)
+                except Exception:
+                    rep = None
+                if rep is not None:
+                    for k in ("exchange_ms", "interior_ms", "frontier_ms",
+                              "hidden_ms"):
+                        timer.record_bucket(k, rep[k])
+                    log("overlap[traced]: exchange {exchange_ms:.3f} ms | "
+                        "interior {interior_ms:.3f} ms | frontier "
+                        "{frontier_ms:.3f} ms | hidden {hidden_ms:.3f} ms "
+                        "per step — collective overlapped interior compute: "
+                        "{verdict}".format(
+                            verdict="YES" if rep["overlapped"] else "NO",
+                            **{k: rep[k] for k in rep}))
+                else:
+                    log("overlap[traced]: no interior/frontier scope spans "
+                        "in the trace window (tools/trace_comm.py "
+                        "--overlap-check <dir> on a --profile-dir trace "
+                        "gives the full report)")
             if auto_trace_dir:
                 shutil.rmtree(auto_trace_dir, ignore_errors=True)
 
@@ -546,6 +594,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     pool.shutdown(wait=True)
 
     res.epoch_time, res.comm_time, res.reduce_time = timer.means()
+    res.overlap_buckets = timer.bucket_means()
     res.final_loss = float(loss)
     res.memory = format_memory_stats()
     log(res.memory)

@@ -36,6 +36,7 @@ from bnsgcn_tpu.data.artifacts import PartitionArtifacts
 from bnsgcn_tpu.models.gnn import GraphEnv, ModelSpec, apply_model, init_params
 from bnsgcn_tpu.ops.spmm import agg_sum
 from bnsgcn_tpu.parallel.halo import (HaloSpec, full_rate_spec, halo_apply,
+                                      halo_finish, halo_start,
                                       make_halo_plan, make_halo_spec,
                                       precompute_exchange)
 from bnsgcn_tpu.parallel.mesh import (make_parts_mesh, parts_sharding,
@@ -140,11 +141,15 @@ class StepFns:
     extra_blk: dict           # extra per-part arrays (ELL layouts) to merge into the block dict
     drop_blk_keys: tuple      # block keys the compiled step does not read (drop to save HBM)
     eval_forward: Callable = None  # mesh-distributed eval-mode forward (full rate)
+    overlap: str = "off"      # RESOLVED --overlap mode ('split' only when the
+                              # train step really runs the interior/frontier
+                              # split; run.py labels the header from this)
 
 
 def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
                rng, edge_chunk: int, training: bool, aggregate=None,
-               gat_ell=None, remat: bool = False) -> GraphEnv:
+               gat_ell=None, remat: bool = False,
+               agg_exchange=None) -> GraphEnv:
     return GraphEnv(
         src=blk.get("src"), dst=blk.get("dst"), n_dst=hspec.pad_inner,
         in_norm=blk["in_norm"], out_norm=blk["out_norm"],
@@ -154,6 +159,7 @@ def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
         training=training, rng=rng, edge_chunk=edge_chunk,
         axis_name=hspec.axis_name, inner_mask=blk["inner_mask"],
         aggregate=aggregate, gat_ell=gat_ell, remat=remat,
+        agg_exchange=agg_exchange,
     )
 
 
@@ -177,12 +183,54 @@ def hybrid_layout_key(cfg: Config) -> str:
     """layout_cache key for the hybrid SpMM under cfg's tiling knobs —
     shared with bench.py's on-disk layout pickles so they cannot drift.
     Uses the EFFECTIVE occupancy, so auto (0) and an equal explicit value
-    share one cache entry, and pre-tile-knob keys stay valid."""
+    share one cache entry, and pre-tile-knob keys stay valid. --overlap
+    split builds a differently-shaped (interior/frontier row-partitioned)
+    layout and gets its own ':ovl' namespace."""
     occ, tile, budget = hybrid_tiling(cfg)
     key = f"hybrid:{occ}:{budget}"
     if tile != 512:
         key += f":t{tile}"
+    if cfg.overlap == "split":
+        key += ":ovl"
     return key
+
+
+def ell_layout_key(cfg: Config) -> str:
+    """layout_cache key for the pure-ELL SpMM ('ell', or 'ell:ovl' for the
+    --overlap split interior/frontier pair)."""
+    return "ell:ovl" if cfg.overlap == "split" else "ell"
+
+
+def _cluster_perms(art: PartitionArtifacts, cfg: Config):
+    """Per-part cluster orders for the hybrid layout (shared by the fused
+    and --overlap split builds)."""
+    from bnsgcn_tpu.ops.block_spmm import cluster_order
+    n_local = art.feat.shape[0]
+    perms_i, perms_e = [], []
+    for p in range(n_local):
+        pi, pe = cluster_order(art.src[p], art.dst[p], art.pad_inner,
+                               art.n_ext, target=cfg.block_tile)
+        perms_i.append(pi)
+        perms_e.append(pe)
+    return np.stack(perms_i), np.stack(perms_e)
+
+
+def _compose_split(spmms, pad_inner: int):
+    """Fused-equivalent aggregation from an (interior, frontier) SpMM pair:
+    int rows gather from the owned prefix, frontier rows from the full
+    extended block, one recombination gather back to row order. Serves the
+    eval/precompute call sites of a --overlap split run so only ONE layout
+    family is ever built (row-exact vs the fused layout)."""
+    int_spmm, fro_spmm = spmms
+
+    def spmm(arrays, h_ext):
+        a_i = {k[4:]: v for k, v in arrays.items() if k.startswith("int_")}
+        a_f = {k[4:]: v for k, v in arrays.items() if k.startswith("fro_")}
+        o_i = int_spmm(a_i, h_ext[:pad_inner])
+        o_f = fro_spmm(a_f, h_ext)
+        return jnp.concatenate([o_i, o_f], 0)[arrays["merge_perm"]]
+
+    return spmm
 
 
 def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
@@ -275,12 +323,64 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                       f"tiles -> {spmm_kind}", file=sys.stderr)
         else:
             spmm_kind = "ell"
+
+    # --overlap split: interior/frontier row-split aggregation so the halo
+    # collective runs concurrently with the interior SpMM (DistGNN-style
+    # local/remote overlap, arXiv:2104.06700). Resolved HERE so the layout
+    # build below emits the row-partitioned pair instead of the fused tables.
+    overlap = cfg.overlap
+    if overlap == "split":
+        reason = None
+        if spec.model not in ("gcn", "graphsage"):
+            reason = (f"model={spec.model!r} aggregates through the masked "
+                      f"edge softmax, which consumes the whole halo block")
+        elif jax.process_count() > 1:
+            reason = ("multi-host partial loads cannot derive the global "
+                      "interior/frontier row split from local parts yet")
+        if reason is not None:
+            if jax.process_index() == 0:
+                print(f"overlap=split unavailable ({reason}); falling back "
+                      f"to --overlap off", file=sys.stderr)
+            overlap = "off"
+    key_cfg = cfg if overlap == cfg.overlap else cfg.replace(overlap=overlap)
+    split_spmms = None                  # (interior, frontier) train instances
+    split_kind = None
+
     want_hybrid = (spmm_kind == "hybrid"
                    and spec.model in ("gcn", "graphsage"))
-    if want_hybrid:
+    if want_hybrid and overlap == "split":
+        from bnsgcn_tpu.ops.block_spmm import (build_split_block_layouts,
+                                               make_block_spmm)
+        hyb_key = hybrid_layout_key(key_cfg)            # 'hybrid:...:ovl'
+        if layout_cache is not None and hyb_key in layout_cache:
+            sb = layout_cache[hyb_key]
+        else:
+            perms_i, perms_e = (auto_perms if auto_perms is not None
+                                else _cluster_perms(art, cfg))
+            sb = build_split_block_layouts(
+                art.src, art.dst, art.pad_inner, art.n_ext, perms_i, perms_e,
+                occupancy_min=hybrid_tiling(cfg)[0],
+                tile_budget_bytes=cfg.block_tile_budget_mb << 20,
+                tile_r=cfg.block_tile, tile_c=cfg.block_tile)
+            if layout_cache is not None:
+                layout_cache[hyb_key] = sb
+        (int_f, int_b, int_pair), (fro_f, fro_b, fro_pair), s_arrays, _, _ = sb
+        mk = partial(make_block_spmm, use_pallas=cfg.use_pallas)
+        split_spmms = (mk(int_f, int_b, int_pair, gather_dtype=cfg.spmm_gather,
+                          dense_dtype=cfg.spmm_dense),
+                       mk(fro_f, fro_b, fro_pair, gather_dtype=cfg.spmm_gather,
+                          dense_dtype=cfg.spmm_dense))
+        split_pre = (mk(int_f, int_b, int_pair, accum="reduce"),
+                     mk(fro_f, fro_b, fro_pair, accum="reduce"))
+        ell_arrays = dict(s_arrays)
+        ell_spmm = _compose_split(split_spmms, art.pad_inner)
+        ell_spmm_pre = _compose_split(split_pre, art.pad_inner)
+        ell_keys = tuple(ell_arrays.keys())
+        split_kind = "hybrid"
+    elif want_hybrid:
         from bnsgcn_tpu.ops.block_spmm import (build_block_layouts,
-                                               cluster_order, make_block_spmm)
-        hyb_key = hybrid_layout_key(cfg)
+                                               make_block_spmm)
+        hyb_key = hybrid_layout_key(key_cfg)
         if layout_cache is not None and hyb_key in layout_cache:
             fwd_b, bwd_b, ell_pair, ell_arrays = layout_cache[hyb_key]
             if cfg.spmm_dense == "int8":
@@ -302,18 +402,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                         multihost_utils.process_allgather(np.asarray(v))
                     ).max(axis=0) for k, v in stats.items()}
 
-            if auto_perms is not None:
-                perms_i, perms_e = auto_perms
-            else:
-                n_local = art.feat.shape[0]
-                perms_i, perms_e = [], []
-                for p in range(n_local):
-                    pi, pe = cluster_order(art.src[p], art.dst[p],
-                                           art.pad_inner, art.n_ext,
-                                           target=cfg.block_tile)
-                    perms_i.append(pi)
-                    perms_e.append(pe)
-                perms_i, perms_e = np.stack(perms_i), np.stack(perms_e)
+            perms_i, perms_e = (auto_perms if auto_perms is not None
+                                else _cluster_perms(art, cfg))
             fwd_b, bwd_b, ell_pair, ell_arrays = build_block_layouts(
                 art.src, art.dst, art.pad_inner, art.n_ext,
                 perms_i, perms_e, agree=agree,
@@ -337,6 +427,32 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                                        use_pallas=cfg.use_pallas,
                                        accum="reduce")
         ell_keys = tuple(ell_arrays.keys())
+    elif (spmm_kind == "ell" and spec.model in ("gcn", "graphsage")
+          and overlap == "split"):
+        from bnsgcn_tpu.ops.ell import build_split_layouts, make_ell_spmm
+        skey = ell_layout_key(key_cfg)                  # 'ell:ovl'
+        if layout_cache is not None and skey in layout_cache:
+            sb = layout_cache[skey]
+        else:
+            sb = build_split_layouts(art.src, art.dst, art.pad_inner,
+                                     art.n_ext)
+            if layout_cache is not None:
+                layout_cache[skey] = sb
+        (int_f, int_b), (fro_f, fro_b), s_arrays, _, _ = sb
+
+        def mke(f, b, **kw):
+            return make_ell_spmm(f, b, len(f.widths), len(b.widths),
+                                 use_pallas=cfg.use_pallas, **kw)
+
+        split_spmms = (mke(int_f, int_b, gather_dtype=cfg.spmm_gather),
+                       mke(fro_f, fro_b, gather_dtype=cfg.spmm_gather))
+        split_pre = (mke(int_f, int_b, accum="reduce"),
+                     mke(fro_f, fro_b, accum="reduce"))
+        ell_arrays = dict(s_arrays)
+        ell_spmm = _compose_split(split_spmms, art.pad_inner)
+        ell_spmm_pre = _compose_split(split_pre, art.pad_inner)
+        ell_keys = tuple(ell_arrays.keys())
+        split_kind = "ell"
     elif spmm_kind == "ell" and spec.model in ("gcn", "graphsage"):
         from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
         if layout_cache is not None and "ell" in layout_cache:
@@ -358,6 +474,12 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                                      use_pallas=cfg.use_pallas,
                                      accum="reduce")
         ell_keys = tuple(ell_arrays.keys())
+    elif overlap == "split" and spec.model in ("gcn", "graphsage"):
+        # 'segment' COO path: the row split is just two edge lists (no
+        # layout build); recombination is an exact add of disjoint rows
+        from bnsgcn_tpu.ops.spmm import split_coo
+        ell_arrays = dict(split_coo(art.src, art.dst, art.pad_inner))
+        split_kind = "segment"
 
     # dense per-row GAT attention over an (uncapped) ELL layout; geometry
     # comes from meta.json ('gat_fwd') or is computed when all parts are local
@@ -403,6 +525,59 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             return None
         return (gat_spec, {k: blk[k] for k in gat_keys})
 
+    def _split_agg_for(blk, plan):
+        """--overlap split layer body: start-exchange -> interior-agg ->
+        finish-exchange -> frontier-agg -> merge. The interior aggregation
+        has NO data dependency on the collective, so the XLA latency-hiding
+        scheduler can run the exchange while it computes. Returned callable
+        becomes GraphEnv.agg_exchange; None keeps the fused layer body."""
+        if overlap != "split":
+            return None
+        out_norm = blk["out_norm"]
+        ni = hspec.pad_inner
+
+        def scale(x, norm):
+            # the GCN symmetric norm, applied piecewise: elementwise
+            # identical to the fused path's single h_ext / out_norm
+            return (x / norm[:, None]).astype(x.dtype)
+
+        if split_kind == "segment":
+            def agg(i, h, scale_out_norm):
+                with jax.named_scope("halo_start"):
+                    recv = halo_start(hspec, plan, h)
+                h_in = scale(h, out_norm[:ni]) if scale_out_norm else h
+                with jax.named_scope("interior_agg"):
+                    o_i = agg_sum(h_in, blk["seg_int_src"],
+                                  blk["seg_int_dst"], ni, cfg.edge_chunk)
+                with jax.named_scope("halo_finish"):
+                    buf = halo_finish(hspec, plan, recv, h)
+                h_halo = scale(buf, out_norm[ni:]) if scale_out_norm else buf
+                with jax.named_scope("frontier_agg"):
+                    o_f = agg_sum(jnp.concatenate([h_in, h_halo], 0),
+                                  blk["seg_fro_src"], blk["seg_fro_dst"],
+                                  ni, cfg.edge_chunk)
+                return o_i + o_f            # disjoint rows: exact recombine
+            return agg
+
+        int_spmm, fro_spmm = split_spmms
+        a_i = {k[4:]: blk[k] for k in ell_keys if k.startswith("int_")}
+        a_f = {k[4:]: blk[k] for k in ell_keys if k.startswith("fro_")}
+        mp = blk["merge_perm"]
+
+        def agg(i, h, scale_out_norm):
+            with jax.named_scope("halo_start"):
+                recv = halo_start(hspec, plan, h)
+            h_in = scale(h, out_norm[:ni]) if scale_out_norm else h
+            with jax.named_scope("interior_agg"):
+                o_i = int_spmm(a_i, h_in)
+            with jax.named_scope("halo_finish"):
+                buf = halo_finish(hspec, plan, recv, h)
+            h_halo = scale(buf, out_norm[ni:]) if scale_out_norm else buf
+            with jax.named_scope("frontier_agg"):
+                o_f = fro_spmm(a_f, jnp.concatenate([h_in, h_halo], 0))
+            return jnp.concatenate([o_i, o_f], 0)[mp]
+        return agg
+
     def local_loss(params, state, blk, tables, epoch, sample_key, drop_key):
         blk = {k: v[0] for k, v in blk.items()}
         plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
@@ -410,7 +585,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
                          aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
-                         remat=cfg.remat)
+                         remat=cfg.remat, agg_exchange=_split_agg_for(blk, plan))
         logits, new_state = apply_model(params, state, spec, blk["feat"], env)
         if multilabel:
             ls = bce_sum(logits, blk["label"], blk["train_mask"])
@@ -445,7 +620,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         if drop_key is not None:
             rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
-                         aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk))
+                         aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
+                         agg_exchange=_split_agg_for(blk, plan))
         logits, _ = apply_model(params, state, spec, blk["feat"], env)
         return logits[None]
 
@@ -532,7 +708,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                   extra_blk=ell_arrays,
                   drop_blk_keys=(("src", "dst")
                                  if (ell_spmm is not None or gat_spec is not None)
-                                 else ()))
+                                 else ()),
+                  overlap=overlap)
     return fns, hspec, tables, tables_full
 
 

@@ -51,12 +51,23 @@ class EpochTimer:
         self.train_dur: list[float] = []
         self.comm_dur: list[float] = []
         self.reduce_dur: list[float] = []
+        # per-step phase buckets (--overlap split observability): trace-
+        # derived 'exchange_ms' / 'interior_ms' / 'frontier_ms' /
+        # 'hidden_ms' device-time attributions (utils/traceparse
+        # .overlap_report); empty for fused runs
+        self.buckets: dict[str, list[float]] = {}
 
     def record(self, epoch: int, train_t: float, comm_t: float = 0.0, reduce_t: float = 0.0):
         if epoch >= self.warmup:
             self.train_dur.append(train_t)
             self.comm_dur.append(comm_t)
             self.reduce_dur.append(reduce_t)
+
+    def record_bucket(self, name: str, value_ms: float):
+        self.buckets.setdefault(name, []).append(float(value_ms))
+
+    def bucket_means(self) -> dict[str, float]:
+        return {k: float(np.mean(v)) for k, v in self.buckets.items() if v}
 
     def means(self) -> tuple[float, float, float]:
         m = lambda xs: float(np.mean(xs)) if xs else 0.0

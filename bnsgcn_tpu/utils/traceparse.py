@@ -30,6 +30,11 @@ import re
 EXCHANGE_PAT = re.compile(r"all-to-all|collective-permute", re.I)
 REDUCE_PAT = re.compile(r"all-reduce|reduce-scatter|all-gather", re.I)
 HOST_PROGRAMS = ("train_step", "exchange_only")
+# --overlap split phase scopes (trainer._split_agg_for wraps the interior /
+# frontier aggregations in jax.named_scope, which XLA threads into op
+# metadata; profiler events carry it in the name or an args value)
+INTERIOR_PAT = re.compile(r"interior_agg", re.I)
+FRONTIER_PAT = re.compile(r"frontier_agg", re.I)
 
 
 def load_trace_events(trace_dir):
@@ -123,16 +128,110 @@ def program_cost(bucket, cat="exchange"):
     return raw, min_est, n, len(lanes)
 
 
-def step_comm_per_epoch(trace_dir):
-    """Per-train_step in-step (exchange_s, reduce_s, n_steps) from a trace.
+def _ev_matches(ev, pat):
+    """Scope match against the event name OR any string arg value (TPU
+    traces carry the HLO op_name metadata — where named_scope lands — in
+    args like 'long_name'/'tf_op' rather than the instruction name)."""
+    if pat.search(ev.get("name", "")):
+        return True
+    args = ev.get("args") or {}
+    return any(isinstance(v, str) and pat.search(v) for v in args.values())
 
-    Min-over-lanes estimate divided by the number of train_step launches in
-    the window. Returns None when the trace is missing/unreadable or holds
-    no train_step launch — callers fall back to the microbench column
-    (tagged [sampled]) rather than printing a fabricated number.
-    """
+
+def _merged(spans):
+    out = []
+    for s, e in sorted(spans):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return out
+
+
+def _intersect_us(a, b):
+    """Total overlap time between two span lists (us)."""
+    a, b = _merged(a), _merged(b)
+    i = j = 0
+    tot = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            tot += e - s
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return tot
+
+
+def overlap_from_events(events):
+    """--overlap split observability: did the halo collective actually run
+    concurrently with the interior SpMM?
+
+    Exchange spans come from the train_step attribution (so exchange_only
+    microbench collectives never pollute the check); interior/frontier
+    compute spans are collected by scope name on the device lanes. Per-lane
+    interval intersection of exchange x interior is the time the wire was
+    genuinely hidden under independent compute. Returns per-step ms buckets
+    {n_steps, exchange_ms, interior_ms, frontier_ms, hidden_ms, overlapped}
+    or None when the trace carries no interior/frontier scopes (a fused run,
+    or a profiler that dropped op metadata)."""
+    attr = attribute(events)
+    steps = attr["train_step"]["launches"]
+    ex_lanes = {lane: [(ts, ts + d) for ts, d in evs]
+                for lane, evs in attr["train_step"]["exchange"].items()}
+    tnames = _thread_names(events)
+    scope_lanes = {"interior": {}, "frontier": {}}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        lane = (ev["pid"], tnames.get((ev["pid"], ev["tid"]), ev["tid"]))
+        if lane[1] == "python":
+            continue
+        for cat, pat in (("interior", INTERIOR_PAT),
+                         ("frontier", FRONTIER_PAT)):
+            if _ev_matches(ev, pat):
+                scope_lanes[cat].setdefault(lane, []).append(
+                    (float(ev["ts"]),
+                     float(ev["ts"]) + float(ev.get("dur", 0.0))))
+    if not scope_lanes["interior"] and not scope_lanes["frontier"]:
+        return None
+    sums = {"exchange": sum(e - s for sp in ex_lanes.values()
+                            for s, e in sp)}
+    for cat in ("interior", "frontier"):
+        sums[cat] = sum(e - s for sp in scope_lanes[cat].values()
+                        for s, e in sp)
+    hidden = sum(_intersect_us(ex_lanes.get(lane, []), sp)
+                 for lane, sp in scope_lanes["interior"].items())
+    n = max(steps, 1)
+    return {"n_steps": steps,
+            "exchange_ms": sums["exchange"] / n / 1e3,
+            "interior_ms": sums["interior"] / n / 1e3,
+            "frontier_ms": sums["frontier"] / n / 1e3,
+            "hidden_ms": hidden / n / 1e3,
+            # 'overlapped' = a meaningful fraction (>5%) of the collective
+            # time coincided with interior compute on the same device lane
+            "overlapped": (hidden > 0.05 * sums["exchange"]
+                           if sums["exchange"] > 0 else False)}
+
+
+def overlap_report(trace_dir):
+    """overlap_from_events over the newest trace in `trace_dir`; None on any
+    parse failure (callers log 'no overlap evidence', never crash)."""
     try:
         events, _ = load_trace_events(trace_dir)
+        return overlap_from_events(events)
+    except Exception:
+        return None
+
+
+def step_comm_from_events(events):
+    """Per-train_step in-step (exchange_s, reduce_s, n_steps) over already-
+    loaded events — run.py loads the trace ONCE and feeds both this and
+    overlap_from_events (a multi-epoch trace re-parse costs seconds of
+    host stall between epochs)."""
+    try:
         attr = attribute(events)
         steps = attr["train_step"]["launches"]
         if steps < 1:
@@ -148,3 +247,17 @@ def step_comm_per_epoch(trace_dir):
         return ex_us / steps / 1e6, rd_us / steps / 1e6, steps
     except Exception:
         return None
+
+
+def step_comm_per_epoch(trace_dir):
+    """step_comm_from_events over the newest trace in `trace_dir`.
+
+    Returns None when the trace is missing/unreadable or holds no
+    train_step launch — callers fall back to the microbench column
+    (tagged [sampled]) rather than printing a fabricated number.
+    """
+    try:
+        events, _ = load_trace_events(trace_dir)
+    except Exception:
+        return None
+    return step_comm_from_events(events)

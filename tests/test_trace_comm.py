@@ -91,6 +91,43 @@ def test_host_lane_collectives_ignored():
     assert raw == 2 * (50 + 10)
 
 
+def test_overlap_report_detects_hidden_exchange():
+    """--overlap split observability: exchange spans that coincide with
+    interior_agg compute on the same device lane count as hidden; scope
+    names are matched in the event name OR any string arg (TPU traces put
+    the named_scope path in op metadata args)."""
+    from bnsgcn_tpu.utils.traceparse import overlap_from_events
+
+    ev = [_meta(1, 0, "python"), _meta(1, 10, "dev0"), _meta(1, 11, "dev1")]
+    ev.append(_ev(1, 0, "PjitFunction(train_step)", 1000.0, 300))
+    # lane dev0: a2a @ [1100, 1180]; interior fusion @ [1120, 1220] (via
+    # args metadata) -> 60 us hidden; frontier afterwards
+    ev.append(_ev(1, 10, "all-to-all.3", 1100.0, 80))
+    fused = _ev(1, 10, "fusion.7", 1120.0, 100)
+    fused["args"] = {"long_name": "jit(train_step)/interior_agg/fusion.7"}
+    ev.append(fused)
+    ev.append(_ev(1, 11, "frontier_agg/add.1", 1200.0, 40))
+    rep = overlap_from_events(ev)
+    assert rep is not None and rep["n_steps"] == 1
+    assert abs(rep["exchange_ms"] - 0.080) < 1e-9
+    assert abs(rep["interior_ms"] - 0.100) < 1e-9
+    assert abs(rep["frontier_ms"] - 0.040) < 1e-9
+    assert abs(rep["hidden_ms"] - 0.060) < 1e-9
+    assert rep["overlapped"]
+
+    # serialized schedule (exchange fully before interior) -> not overlapped
+    ev2 = [_meta(1, 0, "python"), _meta(1, 10, "dev0")]
+    ev2.append(_ev(1, 0, "PjitFunction(train_step)", 1000.0, 300))
+    ev2.append(_ev(1, 10, "all-to-all.3", 1100.0, 80))
+    ev2.append(_ev(1, 10, "interior_agg/fusion.7", 1200.0, 100))
+    rep2 = overlap_from_events(ev2)
+    assert rep2 is not None and not rep2["overlapped"]
+    assert rep2["hidden_ms"] == 0.0
+
+    # fused-run trace (no scope spans at all) -> None, caller logs fallback
+    assert overlap_from_events(make_trace()) is None
+
+
 def test_step_comm_per_epoch_none_without_exchange_events(tmp_path):
     """A trace window holding train_step launches but NO device exchange
     events (observed when the step compiles inside the window on XLA:CPU)

@@ -50,7 +50,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 from bnsgcn_tpu.utils.traceparse import (  # noqa: E402,F401
     EXCHANGE_PAT, REDUCE_PAT, HOST_PROGRAMS, load_trace_events,
-    _thread_names, attribute, program_cost, step_comm_per_epoch)
+    _thread_names, attribute, overlap_from_events, overlap_report,
+    program_cost, step_comm_per_epoch)
 
 
 NON_OP_LANES = ("python", "Steps", "XLA Modules", "TC Overlay")
@@ -136,12 +137,33 @@ def main():
                     help="parse an existing --profile-dir instead")
     ap.add_argument("--breakdown", action="store_true",
                     help="print top device ops by time")
+    ap.add_argument("--overlap-check", type=str, default="",
+                    help="report whether the halo collective overlapped "
+                         "interior SpMM compute in a --overlap split trace "
+                         "(per-step exchange/interior/frontier/hidden ms)")
     ap.add_argument("--wires", type=str, default="native,bf16,int8,fp8")
     ap.add_argument("--parts", type=int, default=8)
     ap.add_argument("--scale", type=float, default=0.05)
     ap.add_argument("--dtype", type=str, default="bfloat16")
     ap.add_argument("--workdir", type=str, default="/tmp/trace_comm")
     args = ap.parse_args()
+
+    if args.overlap_check:
+        rep = overlap_report(args.overlap_check)
+        if rep is None:
+            print("no interior/frontier scope spans in the trace (not an "
+                  "--overlap split run, or the profiler dropped op "
+                  "metadata); nothing to check")
+            return 1
+        verdict = "YES" if rep["overlapped"] else "NO"
+        print(f"collective overlapped interior compute: {verdict}")
+        print(f"  per step ({rep['n_steps']} train steps): "
+              f"exchange {rep['exchange_ms']:.3f} ms | "
+              f"interior {rep['interior_ms']:.3f} ms | "
+              f"frontier {rep['frontier_ms']:.3f} ms | "
+              f"{rep['hidden_ms']:.3f} ms of the exchange hidden under "
+              f"interior compute")
+        return 0
 
     if args.parse:
         events, path = load_trace_events(args.parse)
