@@ -64,6 +64,16 @@ class Config:
     n_nodes: int = 1                    # multi-host: number of processes (jax.distributed)
 
     # --- TPU-specific knobs (no reference equivalent) ---
+    replicas: int = 1                   # replica-axis size of the 2-D
+                                        # ('replicas','parts') mesh: each of N
+                                        # full graph replicas draws an
+                                        # independent BNS boundary sample and
+                                        # the gradient is the fused cross-
+                                        # replica mean (~1/N sampling variance
+                                        # at constant epoch math/replica).
+                                        # Needs replicas*n_partitions devices;
+                                        # 1 = the historical 1-D parts mesh,
+                                        # bit-identical
     dtype: str = "float32"              # compute dtype: 'float32' | 'bfloat16'
     edge_chunk: int = 0                 # >0: aggregate edges in chunks of this size (bounds HBM)
     spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'hybrid'
@@ -190,6 +200,11 @@ def create_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-eval", action="store_false", dest="eval")
     p.set_defaults(eval=True)
     # TPU-specific
+    p.add_argument("--replicas", type=int, default=1,
+                   help="replica-axis size: train N independently-BNS-sampled "
+                        "graph replicas on a ('replicas','parts') mesh and "
+                        "average gradients (needs N*n_partitions devices; "
+                        "use when devices > partitions)")
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--spmm", type=str, default="ell",
                    choices=["ell", "hybrid", "auto", "segment"])

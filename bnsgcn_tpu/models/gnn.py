@@ -100,6 +100,12 @@ class GraphEnv:
     # (GatEllSpec, arrays dict): dense per-row GAT attention over the ELL
     # layout (ops/ell_attention.py) when set; segment softmax otherwise
     remat: bool = False                # jax.checkpoint each layer (HBM for FLOPs+comm)
+    replica_axis: Optional[str] = None # 2-D ('replicas','parts') mesh: SyncBN
+    n_replicas: int = 1                # moments mean over replicas too (one
+                                       # fused psum over both axes, divided by
+                                       # whole_size * n_replicas — each replica
+                                       # sees the whole graph). None/1 = the
+                                       # historical parts-only reduction.
     agg_exchange: Optional[Callable] = None
     # agg_exchange(layer, h [n_dst, d], scale_out_norm) -> [n_dst, d]:
     # fused exchange + sum-aggregation override (--overlap split re-threads
@@ -229,8 +235,15 @@ def _sync_batch_norm(p, st, h, env: GraphEnv, whole_size, momentum=0.1, eps=1e-5
         sum_x = hm.sum(0)
         sum_x2 = (hm * hm).sum(0)
         if env.axis_name is not None:
-            sum_x = jax.lax.psum(sum_x, env.axis_name)
-            sum_x2 = jax.lax.psum(sum_x2, env.axis_name)
+            # replica-axis meshes fold the cross-replica moment mean into
+            # the same psum (one collective over both axes; whole_size
+            # scales by n_replicas below because each replica holds the
+            # full graph, not a shard of it)
+            axes = (env.axis_name if env.replica_axis is None
+                    else (env.replica_axis, env.axis_name))
+            sum_x = jax.lax.psum(sum_x, axes)
+            sum_x2 = jax.lax.psum(sum_x2, axes)
+        whole_size = whole_size * max(env.n_replicas, 1)
         mean = sum_x / whole_size
         # the reference's estimator (module/sync_bn.py:19-20) sums over ALL
         # local rows but divides by whole_size = n_train; when n_train < the

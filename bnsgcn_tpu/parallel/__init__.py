@@ -1,4 +1,6 @@
 from bnsgcn_tpu.parallel.sampling import pair_key, pair_sample
 from bnsgcn_tpu.parallel.halo import HaloSpec, make_halo_plan, halo_apply, sampled_presence
 from bnsgcn_tpu.parallel.mesh import make_parts_mesh
-from bnsgcn_tpu.parallel.reducer import psum_gradients, assert_replicated
+from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc, n_replicas, replica_axis
+from bnsgcn_tpu.parallel.reducer import (assert_replicated, grad_reduce_axes,
+                                         psum_gradients)

@@ -77,6 +77,17 @@ class HaloSpec:
     shift_pads: tuple = ()             # [P-1] per-shift send widths (strategy='shift')
     pair_send: tuple = ()              # [P][P] exact per-pair send sizes (python
                                        # ints — the ragged geometry is static)
+    replica_axis: str | None = None    # 2-D ('replicas','parts') meshes: fold
+                                       # axis_index(replica_axis) into the BNS
+                                       # keys so each replica draws an
+                                       # INDEPENDENT boundary sample. None
+                                       # (1-D path) folds nothing —
+                                       # bit-identical historical keys. Every
+                                       # collective here stays scoped to
+                                       # axis_name='parts' either way: inside
+                                       # shard_map over a 2-D mesh a
+                                       # parts-axis collective acts within
+                                       # each replica's own sub-group.
 
     @property
     def n_halo(self) -> int:
@@ -85,7 +96,8 @@ class HaloSpec:
 
 def make_halo_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
                    rate: float, axis_name: str = "parts",
-                   strategy: str = "padded", wire: str = "native"
+                   strategy: str = "padded", wire: str = "native",
+                   replica_axis: str | None = None
                    ) -> tuple[HaloSpec, dict]:
     """Derive fixed send sizes and ratios from boundary sizes + sampling rate
     (reference get_send_size/get_recv_size, train.py:107-131).
@@ -116,6 +128,7 @@ def make_halo_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
         pad_send=pad_send, axis_name=axis_name, exact=exact,
         strategy=strategy, wire=wire, shift_pads=tuple(shift_pads),
         pair_send=tuple(map(tuple, send_size.tolist())),
+        replica_axis=replica_axis,
     )
     tables = {"n_b": jnp.asarray(n_b, jnp.int32),
               "send_size": jnp.asarray(send_size, jnp.int32),
@@ -250,8 +263,16 @@ def make_halo_plan(spec: HaloSpec, tables: dict, bnd: jax.Array,
         pos, valid = jax.vmap(lambda n: identity_sample(n, Sp))(n_send)
         rpos, rvalid = jax.vmap(lambda n: identity_sample(n, Sp))(n_recv)
     else:
-        send_keys = jax.vmap(lambda j: pair_key(base_key, epoch, me, j))(peers)
-        recv_keys = jax.vmap(lambda q: pair_key(base_key, epoch, q, me))(peers)
+        # replica-axis meshes: each replica folds its own index into the
+        # pair keys, drawing an independent BNS sample from the one shared
+        # base seed (both endpoints of a pair live in the same replica row,
+        # so the zero-communication shared-PRNG contract is unchanged)
+        rep = (jax.lax.axis_index(spec.replica_axis)
+               if spec.replica_axis is not None else None)
+        send_keys = jax.vmap(
+            lambda j: pair_key(base_key, epoch, me, j, replica=rep))(peers)
+        recv_keys = jax.vmap(
+            lambda q: pair_key(base_key, epoch, q, me, replica=rep))(peers)
         pos, valid = jax.vmap(
             lambda k, n, s: pair_sample(k, n, s, Bp, Sp))(send_keys, n_send, s_send)
         rpos, rvalid = jax.vmap(

@@ -26,8 +26,27 @@ import jax
 import jax.numpy as jnp
 
 
-def psum_gradients(grads, axis_name: str = "parts", n_train: int | None = None):
+def grad_reduce_axes(axis_name: str = "parts",
+                     replica_axis: str | None = None):
+    """Mesh axes of the ONE fused gradient/loss psum.
+
+    On the 2-D ('replicas', 'parts') mesh (parallel/replicas.py) the
+    cross-replica gradient MEAN is fused into the existing parts-axis
+    reduction: the loss sums per-device losses with a single psum over BOTH
+    axes and the 1/n_replicas rescale rides the existing 1/n_train scalar —
+    never a second collective (XLA emits one all-reduce over the full mesh,
+    which it can still overlap with the backward exactly as on the 1-D
+    path). replica_axis=None returns the bare parts axis: the historical
+    1-D reduction, bit-identical."""
+    if replica_axis is None:
+        return axis_name
+    return (replica_axis, axis_name)
+
+
+def psum_gradients(grads, axis_name="parts", n_train: int | None = None):
     """Explicit SUM all-reduce of per-device gradients (+ optional /n_train).
+    `axis_name` may be a tuple (e.g. grad_reduce_axes('parts', 'replicas'))
+    — still ONE collective.
 
     Use ONLY when the gradients were computed per-device inside shard_map
     without a replicated-param transpose — the default trainer path must NOT

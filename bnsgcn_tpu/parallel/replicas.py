@@ -1,0 +1,110 @@
+"""Replica-axis hybrid parallelism: a 2-D ('replicas', 'parts') mesh.
+
+BNS-GCN's sampled halo exchange trades communication for gradient variance
+(the paper's central knob); this module spends *spare devices* to buy that
+variance back. When a pod slice has more chips than graph partitions, the
+extra chips form a second mesh axis of full replicas of the partitioned
+graph: every replica runs the SAME partition-parallel step but draws an
+INDEPENDENT boundary sample (parallel/sampling.pair_key folds the replica
+index into the shared-PRNG stream), and the gradient is the cross-replica
+mean — cutting per-step BNS gradient variance by ~1/R at constant epoch
+math per replica (Plexus/DistGNN-style: scale full-graph training by adding
+parallel axes beyond the partition axis).
+
+Axis layout: 'replicas' is the OUTER mesh axis. Replica-axis traffic is one
+fused gradient all-reduce per step (see parallel/reducer.grad_reduce_axes —
+the cross-replica mean rides the SAME psum as the parts-axis reduction,
+rescaled, never a second collective), so it tolerates the slow hop of a
+(DCN, ICI) device order; the per-layer halo all_to_all stays scoped to the
+inner 'parts' axis, where `jax.lax.axis_index('parts')` / collectives over
+axis_name='parts' automatically act within each replica's sub-group.
+
+`n_replicas == 1` returns the plain 1-D ('parts',) mesh — bit-identical to
+the historical path by construction (same Mesh, same specs, same compiled
+program), which tests/test_replicas.py pins across the full halo-strategy x
+wire-codec matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+
+REPLICA_AXIS = "replicas"
+PARTS_AXIS = "parts"
+
+
+def make_mesh(n_parts: int, n_replicas: int = 1, devices=None) -> Mesh:
+    """('replicas', 'parts') mesh of n_replicas x n_parts devices.
+
+    n_replicas == 1 (the default) delegates to `make_parts_mesh`: the 1-D
+    ('parts',) mesh, so every existing call site and compiled program is
+    unchanged unless a second axis was explicitly requested.
+
+    Replicas take the outer axis: with `jax.distributed` multi-host device
+    ordering (process-major), consecutive devices land in the same replica
+    row, keeping the per-layer halo exchange on the fast intra-slice hop and
+    only the once-per-step fused gradient reduce on the slow outer hop."""
+    if n_replicas <= 1:
+        return make_parts_mesh(n_parts, devices)
+    if devices is None:
+        devices = jax.devices()
+    need = n_parts * n_replicas
+    if len(devices) < need:
+        raise ValueError(
+            f"need >= {need} devices for {n_replicas} replicas x {n_parts} "
+            f"partitions, have {len(devices)}; lower --replicas (devices // "
+            f"n_parts = {len(devices) // max(n_parts, 1)} fit) or use a CPU "
+            f"mesh via XLA_FLAGS=--xla_force_host_platform_device_count={need}")
+    arr = np.asarray(devices[:need]).reshape(n_replicas, n_parts)
+    return Mesh(arr, (REPLICA_AXIS, PARTS_AXIS))
+
+
+def n_replicas(mesh: Mesh) -> int:
+    """Replica-axis size of a mesh; 1 for the historical 1-D parts mesh."""
+    return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        REPLICA_AXIS, 1))
+
+
+def replica_axis(mesh: Mesh):
+    """'replicas' when the mesh carries the axis, else None — the value
+    threaded into HaloSpec.replica_axis so `make_halo_plan` folds the
+    replica index into the BNS sampling keys (and 1-D meshes never pay a
+    fold, preserving bit-identity)."""
+    return REPLICA_AXIS if REPLICA_AXIS in mesh.axis_names else None
+
+
+def mesh_desc(mesh: Mesh) -> str:
+    """Human-readable mesh shape for run headers: '2x4 replicas x parts'
+    on a 2-D mesh, '4 parts' on the historical 1-D mesh."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if REPLICA_AXIS in shape:
+        return (f"{shape[REPLICA_AXIS]}x{shape[PARTS_AXIS]} "
+                f"replicas x parts")
+    return f"{shape[PARTS_AXIS]} parts"
+
+
+def stacked_spec(mesh: Mesh) -> P:
+    """PartitionSpec stacking per-device rows along dim 0: (replicas, parts)
+    together on a 2-D mesh (global [R*P, ...], replica-major), plain
+    ('parts',) on 1-D. Used as the shard_map out_spec for outputs that
+    genuinely differ per replica (training-mode logits under independent
+    BNS draws, the exchange-only microbench sum)."""
+    if REPLICA_AXIS in mesh.axis_names:
+        return P((REPLICA_AXIS, PARTS_AXIS))
+    return P(PARTS_AXIS)
+
+
+def dedup_replica0(out, mesh: Mesh, n_parts: int):
+    """Replica 0's [n_parts, ...] slice of a `stacked_spec` output.
+
+    Metric/eval outputs are de-duplicated to replica 0 so the host-side
+    reporting pipeline (accuracy logs, result files, _gather_logits) sees
+    the same [P, ...] shape regardless of the replica axis. `stacked_spec`
+    is replica-major, so replica 0 is the leading n_parts rows."""
+    if REPLICA_AXIS in mesh.axis_names:
+        return out[:n_parts]
+    return out

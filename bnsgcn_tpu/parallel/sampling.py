@@ -17,13 +17,47 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
-def pair_key(base_key: jax.Array, epoch: jax.Array, p, j) -> jax.Array:
-    """Key shared by sender p and receiver j for one epoch."""
-    k = jax.random.fold_in(base_key, epoch)
-    k = jax.random.fold_in(k, p)
-    return jax.random.fold_in(k, j)
+def _fold_guard(x, name: str):
+    """Edge guard for `jax.random.fold_in` operands.
+
+    fold_in folds its data argument as a single uint32 word; a Python int
+    outside [0, 2**32) would silently wrap (two distinct epochs 2**32 apart
+    collide onto one key — the same stream for "different" draws), and a
+    negative id would alias a large positive one. Static (Python/numpy
+    scalar) inputs are range-checked here; traced values (the vmapped peer
+    index, the uint32 epoch counter) are already dtype-bounded by
+    construction. Returns x unchanged."""
+    if isinstance(x, (int, np.integer)):
+        if not 0 <= int(x) < 2 ** 32:
+            raise ValueError(
+                f"pair_key {name}={x} outside the uint32 fold_in range "
+                f"[0, 2**32): fold_in would silently wrap and alias another "
+                f"{name}'s sampling stream")
+    return x
+
+
+def pair_key(base_key: jax.Array, epoch: jax.Array, p, j,
+             replica=None) -> jax.Array:
+    """Key shared by sender p and receiver j for one epoch.
+
+    `replica` (2-D replica-axis meshes, parallel/replicas.py) is folded
+    FIRST, so ``pair_key(base, e, p, j, replica=r)`` equals
+    ``pair_key(fold_in(base, r), e, p, j)`` — replica r of a 2-D run draws
+    exactly the stream a single-replica run with the folded base key would,
+    which is what makes the cross-replica gradient mean testable against
+    independently-seeded 1-D runs (tests/test_replicas.py). ``replica=None``
+    (the 1-D path) performs no fold at all: bit-identical to the historical
+    keys. Distinctness of (replica, epoch, p, j) tuples is pinned by an
+    exhaustive-grid test (threefry fold_in is injective per word; the guard
+    above keeps every operand inside the one-word range)."""
+    if replica is not None:
+        base_key = jax.random.fold_in(base_key, _fold_guard(replica, "replica"))
+    k = jax.random.fold_in(base_key, _fold_guard(epoch, "epoch"))
+    k = jax.random.fold_in(k, _fold_guard(p, "p"))
+    return jax.random.fold_in(k, _fold_guard(j, "j"))
 
 
 def pair_sample(key: jax.Array, n_valid: jax.Array, s_valid: jax.Array,

@@ -37,7 +37,7 @@ from bnsgcn_tpu.data.graph import Graph
 from bnsgcn_tpu.data.partitioner import partition_graph
 from bnsgcn_tpu.evaluate import evaluate_induc, evaluate_mesh, evaluate_trans
 from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
-from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc
 from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns, init_training,
                                 local_part_ids, place_blocks, place_blocks_local,
                                 place_replicated)
@@ -126,7 +126,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     train_g = g.subgraph(g.train_mask) if (cfg.inductive and g is not None) else g
 
     # ---- mesh + partition artifacts ----
-    mesh = make_parts_mesh(cfg.n_partitions, devices)
+    # --replicas N > 1: 2-D ('replicas','parts') mesh — each replica row
+    # trains the same partitioned graph under an independent BNS draw and
+    # gradients are the fused cross-replica mean (parallel/replicas.py)
+    if cfg.replicas > 1 and multi_host:
+        raise ValueError(
+            "--replicas > 1 is single-host for now (multi-host processes map "
+            "to parts slots only); run with --replicas 1 across hosts")
+    mesh = make_mesh(cfg.n_partitions, cfg.replicas, devices)
     if multi_host and art is not None:
         n_local = len(local_part_ids(mesh))
         if art.feat.shape[0] != n_local:
@@ -244,10 +251,16 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                   if cfg.halo_exchange == "auto" else hspec.strategy)
     if fns.overlap == "split":
         halo_label += "+ovl"
-    log(f"Mesh: {cfg.n_partitions} parts | pad_inner={art.pad_inner} "
+    if fns.n_replicas > 1:
+        halo_label += f"+rep{fns.n_replicas}"
+    # wire bytes are PER REPLICA per device (each replica row runs its own
+    # parts-axis exchange) and reported exactly once — the replica axis adds
+    # one fused gradient all-reduce per step, never more halo traffic
+    per_rep = "/replica" if fns.n_replicas > 1 else ""
+    log(f"Mesh: {mesh_desc(mesh)} | pad_inner={art.pad_inner} "
         f"pad_boundary={art.pad_boundary} pad_send={hspec.pad_send} "
         f"edges/part={art.pad_edges} | halo {halo_label}/{hspec.wire}: "
-        f"{wire_bytes(hspec, cfg.n_hidden, nb) / 1e6:.2f} MB/exchange/device "
+        f"{wire_bytes(hspec, cfg.n_hidden, nb) / 1e6:.2f} MB/exchange/device{per_rep} "
         f"at hidden width {cfg.n_hidden}"
         + ("" if spec.use_pp or spec.model == "gat" else
            f" ({wire_bytes(hspec, max(cfg.n_feat, 1), nb) / 1e6:.2f} MB at "
@@ -571,14 +584,18 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                     best_acc, best_params = acc, p_eval
             p_host = jax.device_get(params)
             s_host = jax.device_get(state)
+            # bind the epoch label like the params: the thread may run after
+            # the loop has advanced, and a late-bound `epoch` mislabels the
+            # eval line (observed as an "Epoch 00020" eval in a log_every=10
+            # run)
             if cfg.inductive:
                 pending = pool.submit(
-                    lambda p=p_host, s=s_host: (p, evaluate_induc(
-                        "Epoch %05d" % epoch, p, s, spec, val_g, "val", result_file)))
+                    lambda p=p_host, s=s_host, e=epoch: (p, evaluate_induc(
+                        "Epoch %05d" % e, p, s, spec, val_g, "val", result_file)))
             else:
                 pending = pool.submit(
-                    lambda p=p_host, s=s_host: (p, evaluate_trans(
-                        "Epoch %05d" % epoch, p, s, spec, val_g, result_file)[0]))
+                    lambda p=p_host, s=s_host, e=epoch: (p, evaluate_trans(
+                        "Epoch %05d" % e, p, s, spec, val_g, result_file)[0]))
 
     if tracing:
         # run ended inside the window (epoch loop shorter than prof_stop)

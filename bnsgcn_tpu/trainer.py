@@ -41,6 +41,10 @@ from bnsgcn_tpu.parallel.halo import (HaloSpec, full_rate_spec, halo_apply,
                                       precompute_exchange)
 from bnsgcn_tpu.parallel.mesh import (make_parts_mesh, parts_sharding,
                                        replicated_sharding, shard_map)
+from bnsgcn_tpu.parallel.reducer import grad_reduce_axes
+from bnsgcn_tpu.parallel.replicas import (dedup_replica0, stacked_spec,
+                                          n_replicas as mesh_n_replicas,
+                                          replica_axis as mesh_replica_axis)
 
 # --spmm auto picks the dense-tile hybrid when at least this fraction of
 # edges would densify onto MXU tiles (v5e measured: hybrid wins at 78.5%
@@ -144,12 +148,19 @@ class StepFns:
     overlap: str = "off"      # RESOLVED --overlap mode ('split' only when the
                               # train step really runs the interior/frontier
                               # split; run.py labels the header from this)
+    loss_and_grad: Callable = None  # (params, state, epoch, blk, tables, keys)
+                              # -> (loss, grads): the train step's fused-mean
+                              # gradient without the optimizer update —
+                              # exactness tests compare replica-mesh grads
+                              # against means of 1-D runs through this
+    n_replicas: int = 1       # replica-axis size of the mesh the fns compiled
+                              # for (parallel/replicas.py; 1 = historical 1-D)
 
 
 def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
                rng, edge_chunk: int, training: bool, aggregate=None,
                gat_ell=None, remat: bool = False,
-               agg_exchange=None) -> GraphEnv:
+               agg_exchange=None, n_replicas: int = 1) -> GraphEnv:
     return GraphEnv(
         src=blk.get("src"), dst=blk.get("dst"), n_dst=hspec.pad_inner,
         in_norm=blk["in_norm"], out_norm=blk["out_norm"],
@@ -159,6 +170,7 @@ def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
         training=training, rng=rng, edge_chunk=edge_chunk,
         axis_name=hspec.axis_name, inner_mask=blk["inner_mask"],
         aggregate=aggregate, gat_ell=gat_ell, remat=remat,
+        replica_axis=hspec.replica_axis, n_replicas=n_replicas,
         agg_exchange=agg_exchange,
     )
 
@@ -260,13 +272,30 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         if jax.process_index() == 0:
             print(f"halo-exchange=auto: {why} -> {halo_strategy}",
                   file=sys.stderr)
+    # 2-D ('replicas', 'parts') mesh (parallel/replicas.py): each replica row
+    # runs its own parts-axis halo exchange with an independently-folded BNS
+    # sample; the gradient mean over replicas is fused into the loss psum.
+    # A 1-D mesh leaves every value below at its historical default —
+    # bit-identical code path.
+    n_rep = mesh_n_replicas(mesh)
+    rep_axis = mesh_replica_axis(mesh)
+    if n_rep > 1 and jax.process_count() > 1:
+        raise ValueError(
+            "replica-axis meshes are single-host for now: multi-host partial "
+            "artifact loading maps processes to parts slots only (use "
+            "--replicas 1 across hosts, or give every replica row its own "
+            "single-host run)")
     hspec, tables = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, rate,
-                                   strategy=halo_strategy, wire=cfg.halo_wire)
+                                   strategy=halo_strategy, wire=cfg.halo_wire,
+                                   replica_axis=rep_axis)
     hspec_full, tables_full = full_rate_spec(art.n_b, art.pad_inner, art.pad_boundary)
     n_train = max(art.n_train, 1)
     multilabel = art.multilabel
     axis = hspec.axis_name
-    blk_spec = P("parts")
+    loss_axes = grad_reduce_axes(axis, rep_axis)   # ONE fused psum; /n_rep
+    loss_denom = n_train * n_rep                   # rides the /n_train scale
+    blk_spec = P("parts")                          # replicated over replicas
+    stacked = stacked_spec(mesh)                   # per-replica-varying outs
     rep = P()
 
     # scatter-free SpMM layouts (GCN/SAGE aggregation path): 'ell' (bucketed
@@ -578,20 +607,35 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             return jnp.concatenate([o_i, o_f], 0)[mp]
         return agg
 
+    def _replica_fold(key):
+        """Fold the replica index into a host-fed PRNG key so each replica's
+        dropout stream is independent — folded FIRST, mirroring
+        sampling.pair_key's replica fold, so replica r of a 2-D run equals a
+        1-D run fed fold_in(key, r). 1-D meshes fold nothing."""
+        if rep_axis is None:
+            return key
+        return jax.random.fold_in(key, jax.lax.axis_index(rep_axis))
+
     def local_loss(params, state, blk, tables, epoch, sample_key, drop_key):
         blk = {k: v[0] for k, v in blk.items()}
         plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
         me = jax.lax.axis_index(axis)
-        rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
+        rng = jax.random.fold_in(
+            jax.random.fold_in(_replica_fold(drop_key), epoch), me)
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
                          aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
-                         remat=cfg.remat, agg_exchange=_split_agg_for(blk, plan))
+                         remat=cfg.remat, agg_exchange=_split_agg_for(blk, plan),
+                         n_replicas=n_rep)
         logits, new_state = apply_model(params, state, spec, blk["feat"], env)
         if multilabel:
             ls = bce_sum(logits, blk["label"], blk["train_mask"])
         else:
             ls = ce_sum(logits, blk["label"], blk["train_mask"])
-        loss = jax.lax.psum(ls / n_train, axis)
+        # the cross-replica mean is FUSED here: one psum over both mesh axes,
+        # rescaled by n_replicas — the AD transpose of the replicated params
+        # therefore emits one gradient all-reduce over the whole mesh, whose
+        # result is exactly mean-over-replicas of the per-replica gradients
+        loss = jax.lax.psum(ls / loss_denom, loss_axes)
         return loss, new_state
 
     sharded_loss = shard_map(
@@ -612,28 +656,41 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         params = optax.apply_updates(params, updates)
         return params, new_state, opt_state, loss
 
+    @jax.jit
+    def loss_and_grad(params, state, epoch, blk, tables, sample_key, drop_key):
+        """The step's loss + fused-mean gradient, optimizer untouched —
+        what tests compare across mesh shapes (replica-mean exactness)."""
+        (loss, _), grads = jax.value_and_grad(global_loss, has_aux=True)(
+            params, state, blk, tables, epoch, sample_key, drop_key)
+        return loss, grads
+
     def local_forward(params, state, blk, tables, epoch, sample_key, drop_key):
         blk = {k: v[0] for k, v in blk.items()}
         plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
         me = jax.lax.axis_index(axis)
         rng = None
         if drop_key is not None:
-            rng = jax.random.fold_in(jax.random.fold_in(drop_key, epoch), me)
+            rng = jax.random.fold_in(
+                jax.random.fold_in(_replica_fold(drop_key), epoch), me)
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
                          aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
-                         agg_exchange=_split_agg_for(blk, plan))
+                         agg_exchange=_split_agg_for(blk, plan),
+                         n_replicas=n_rep)
         logits, _ = apply_model(params, state, spec, blk["feat"], env)
         return logits[None]
 
     @jax.jit
     def forward(params, state, epoch, blk, tables, sample_key, drop_key=None):
-        """Training-mode forward (per-epoch sampling active), logits per part."""
+        """Training-mode forward (per-epoch sampling active), logits per part.
+        Replica meshes de-duplicate the report to replica 0's draw so the
+        host-side consumers keep the [P, pad_inner, C] shape."""
         f = shard_map(
             partial(local_forward),
             mesh=mesh,
             in_specs=(rep, rep, blk_spec, rep, rep, rep, rep),
-            out_specs=blk_spec)
-        return f(params, state, blk, tables, epoch, sample_key, drop_key)
+            out_specs=stacked)
+        out = f(params, state, blk, tables, epoch, sample_key, drop_key)
+        return dedup_replica0(out, mesh, hspec.n_parts)
 
     def local_eval(params, state, blk, tables_full):
         """Mesh-distributed full-rate eval forward (capability upgrade over
@@ -653,10 +710,13 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     @jax.jit
     def eval_forward(params, state, blk, tables_full):
+        # full-rate eval is deterministic, so every replica computes the
+        # same logits; metrics de-duplicate to replica 0's copy
         f = shard_map(local_eval, mesh=mesh,
                           in_specs=(rep, rep, blk_spec, rep),
-                          out_specs=blk_spec)
-        return f(params, state, blk, tables_full)
+                          out_specs=stacked)
+        return dedup_replica0(f(params, state, blk, tables_full),
+                              mesh, hspec.n_parts)
 
     def local_precompute(blk, tables_full):
         blk = {k: v[0] for k, v in blk.items()}
@@ -679,9 +739,12 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     @jax.jit
     def precompute(blk, tables_full):
+        # one-time, full-rate, key-free — replicas compute identical copies;
+        # de-dup to replica 0 so the result drops back into the P('parts')
+        # block dict (re-replicated over the replica axis on placement)
         f = shard_map(local_precompute, mesh=mesh,
-                          in_specs=(blk_spec, rep), out_specs=blk_spec)
-        return f(blk, tables_full)
+                          in_specs=(blk_spec, rep), out_specs=stacked)
+        return dedup_replica0(f(blk, tables_full), mesh, hspec.n_parts)
 
     def local_exchange_only(blk, tables, epoch, sample_key, width):
         blk = {k: v[0] for k, v in blk.items()}
@@ -695,10 +758,11 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         return jnp.sum(out)[None]
 
     def exchange_only(blk, tables, epoch, sample_key, width):
-        """Isolated halo exchange x n_graph_layers — the Comm(s) microbench."""
+        """Isolated halo exchange x n_graph_layers — the Comm(s) microbench.
+        Per-replica sums differ (independent draws): stacked out spec."""
         f = shard_map(partial(local_exchange_only, width=width),
                           mesh=mesh,
-                          in_specs=(blk_spec, rep, rep, rep), out_specs=blk_spec)
+                          in_specs=(blk_spec, rep, rep, rep), out_specs=stacked)
         return f(blk, tables, epoch, sample_key)
 
     fns = StepFns(train_step=train_step, forward=forward,
@@ -709,7 +773,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                   drop_blk_keys=(("src", "dst")
                                  if (ell_spmm is not None or gat_spec is not None)
                                  else ()),
-                  overlap=overlap)
+                  overlap=overlap,
+                  loss_and_grad=loss_and_grad,
+                  n_replicas=n_rep)
     return fns, hspec, tables, tables_full
 
 

@@ -226,6 +226,104 @@ def overlap_report(trace_dir):
         return None
 
 
+def _replica_groups(ev):
+    """Parse the HLO `replica_groups={{0,1},{2,3}}` attribute from a
+    collective event's name or string args (TPU traces carry the HLO text
+    in 'long_name'/'hlo_text' metadata). None when absent — CPU traces and
+    stripped profiles fall back to the op-kind heuristic in comm_by_axis."""
+    texts = [ev.get("name", "")]
+    texts += [v for v in (ev.get("args") or {}).values() if isinstance(v, str)]
+    for s in texts:
+        m = re.search(r"replica_groups=\{(\{[^{}]*\}(?:,\{[^{}]*\})*)\}", s)
+        if m:
+            return [[int(x) for x in grp.split(",") if x.strip()]
+                    for grp in re.findall(r"\{([^{}]*)\}", m.group(1))]
+    return None
+
+
+def classify_axis(groups, n_parts: int, n_replicas: int = 1) -> str:
+    """Mesh axis a collective's replica_groups reduce over, for the
+    ('replicas', 'parts') device order of parallel/replicas.make_mesh
+    (device id = r * n_parts + p, replicas outer):
+
+      * groups of n_parts CONSECUTIVE ids        -> 'parts' (halo traffic,
+        one group per replica row);
+      * groups of n_replicas ids at stride P     -> 'replicas' (a pure
+        replica-axis reduce — the fused trainer never emits one, so seeing
+        it flags an unfused double collective);
+      * one group of every device               -> 'replicas x parts' (the
+        fused gradient/loss reduce; plain 'parts' on a 1-D mesh).
+    """
+    if not groups or not groups[0]:
+        return "unknown"
+    size = len(groups[0])
+    if any(len(g) != size for g in groups):
+        return "unknown"
+    if size == n_parts * n_replicas:
+        return "replicas x parts" if n_replicas > 1 else "parts"
+    if size == n_parts and all(
+            g == list(range(g[0], g[0] + n_parts)) and g[0] % n_parts == 0
+            for g in groups):
+        return "parts"
+    if n_replicas > 1 and size == n_replicas and all(
+            all(b - a == n_parts for a, b in zip(g, g[1:])) for g in groups):
+        return "replicas"
+    return "unknown"
+
+
+def comm_by_axis(events, n_parts: int, n_replicas: int = 1):
+    """Device collective time grouped by mesh axis: {axis: {kind: us}}.
+
+    `kind` is 'exchange' (all-to-all / collective-permute — the per-layer
+    halo hop) or 'reduce' (all-reduce family — the fused gradient mean).
+    Axis comes from the event's replica_groups when the trace carries HLO
+    metadata; otherwise the op kind decides (halo exchanges only ever ride
+    'parts'; the trainer's one reduce spans the full mesh), so a pod trace
+    still separates parts-axis halo traffic from the replica-axis gradient
+    fusion even when the profiler strips attributes.
+
+    Spans are reduced with the SAME min-over-lanes estimator as
+    `program_cost`: lane i's k-th collective span includes its rendezvous
+    wait for the other participants, so the minimum across lanes at each
+    position ~= the last-arriver's span ~= the true op cost. A raw
+    cross-lane sum would multiply every op by the lane count and skew
+    toward whichever axis accumulates more straggler wait (the 1.5-26x
+    overstatement documented at the top of this module) — exactly the
+    comparison --by-axis exists to get right."""
+    tnames = _thread_names(events)
+    by_key = {}                 # (axis, kind) -> {lane: [(ts, dur), ...]}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if EXCHANGE_PAT.search(name):
+            kind = "exchange"
+        elif REDUCE_PAT.search(name):
+            kind = "reduce"
+        else:
+            continue
+        lane = (ev.get("pid"),
+                tnames.get((ev.get("pid"), ev.get("tid")), ev.get("tid")))
+        if lane[1] == "python":
+            continue
+        groups = _replica_groups(ev)
+        if groups is not None:
+            axis = classify_axis(groups, n_parts, n_replicas)
+        elif kind == "exchange":
+            axis = "parts"
+        else:
+            axis = "replicas x parts" if n_replicas > 1 else "parts"
+        by_key.setdefault((axis, kind), {}).setdefault(lane, []).append(
+            (float(ev["ts"]), float(ev.get("dur", 0.0))))
+    out = {}
+    for (axis, kind), lanes in by_key.items():
+        for evs in lanes.values():
+            evs.sort()
+        _, est, _, _ = program_cost({kind: lanes}, kind)
+        out.setdefault(axis, {})[kind] = est
+    return out
+
+
 def step_comm_from_events(events):
     """Per-train_step in-step (exchange_s, reduce_s, n_steps) over already-
     loaded events — run.py loads the trace ONCE and feeds both this and

@@ -68,9 +68,17 @@ def test_vname_vocabulary_stable():
         ("hybrid", True, "int8", "int8", 256): "hybrid+pallas+i8g+i8d+t256",
         ("hybrid", False, "fp8", "int8", 512): "hybrid+f8g+i8d",
         ("ell", False, "int8", "native", 512): "ell+i8g",
+        # 8th field: replica-axis size (queued rep2 lines depend on these)
+        ("hybrid", True, "native", "native", 512, "padded", "off", 2):
+            "hybrid+pallas+rep2",
+        ("hybrid", True, "native", "native", 512, "ragged", "split", 2):
+            "hybrid+pallas+rag+ovl+rep2",
+        ("ell", False, "native", "native", 512, "padded", "off", 2):
+            "ell+rep2",
     }
     for v, name in cases.items():
         assert b._vname(v) == name
+        assert b._vrep(v) == (v[7] if len(v) > 7 else 1)
 
 
 def test_record_anchor_and_best_share_entry_without_clobbering(tmp_path):

@@ -1,0 +1,347 @@
+"""Replica-axis hybrid parallelism exactness (parallel/replicas.py).
+
+The acceptance matrix for the 2-D ('replicas', 'parts') mesh:
+
+  (a) --replicas 1 is BIT-identical (fwd + bwd) to the historical 1-D
+      ('parts',) path across the full halo-strategy x wire-codec matrix;
+  (b) --replicas 2 on a 4 parts x 2 replicas CPU mesh produces exactly the
+      mean of the two corresponding single-replica runs (sample and dropout
+      keys folded with the replica index — pair_key's fold-first contract),
+      at rate 1.0 and 0.5;
+  (c) checkpoints round-trip replica-invariantly (params are replicated over
+      both axes, so a 2-D run's checkpoint restores into a 1-D run bitwise
+      and vice versa);
+
+plus the pair_key distinctness/overflow guard (sampling satellite).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu.config import Config
+from bnsgcn_tpu.data.artifacts import build_artifacts
+from bnsgcn_tpu.data.graph import sbm_graph, synthetic_graph
+from bnsgcn_tpu.data.partitioner import partition_graph
+from bnsgcn_tpu.models.gnn import ModelSpec, init_params
+from bnsgcn_tpu.parallel.mesh import make_parts_mesh
+from bnsgcn_tpu.parallel.replicas import (dedup_replica0, make_mesh,
+                                          mesh_desc, n_replicas,
+                                          replica_axis)
+from bnsgcn_tpu.parallel.sampling import pair_key
+from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns,
+                                init_training, place_blocks, place_replicated)
+
+
+def _setup(g, n_parts, cfg, spec, mesh, art=None):
+    if art is None:
+        pid = partition_graph(g, n_parts, method="random", seed=3)
+        art = build_artifacts(g, pid)
+    fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
+    blk_np = build_block_arrays(art, spec.model)
+    blk_np.update(fns.extra_blk)
+    blk = place_blocks(blk_np, mesh)
+    tables = place_replicated(tables, mesh)
+    tables_full = place_replicated(tables_full, mesh)
+    if spec.use_pp:
+        out = fns.precompute(blk, tables_full)
+        if spec.model == "gat":
+            blk["feat0_ext"] = out
+        else:
+            blk["feat"] = out
+    return art, fns, blk, tables
+
+
+def _np_tree(t):
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), t)
+
+
+# ----------------------------------------------------------------------------
+# mesh construction
+# ----------------------------------------------------------------------------
+
+def test_make_mesh_replicas1_is_the_parts_mesh():
+    """R=1 must not even construct a second axis: same Mesh as the
+    historical path, so every compiled program is shared verbatim."""
+    m1 = make_mesh(4, 1)
+    m0 = make_parts_mesh(4)
+    assert m1.axis_names == m0.axis_names == ("parts",)
+    assert list(m1.devices.flat) == list(m0.devices.flat)
+    assert n_replicas(m1) == 1 and replica_axis(m1) is None
+    assert mesh_desc(m1) == "4 parts"
+
+
+def test_make_mesh_2d_layout():
+    m = make_mesh(4, 2)
+    assert m.axis_names == ("replicas", "parts")   # replicas OUTER (DCN)
+    assert m.devices.shape == (2, 4)
+    assert n_replicas(m) == 2 and replica_axis(m) == "replicas"
+    assert mesh_desc(m) == "2x4 replicas x parts"
+    devs = jax.devices()
+    # row r holds devices [r*P, (r+1)*P): consecutive ids share a replica
+    assert list(m.devices[0]) == devs[:4]
+    assert list(m.devices[1]) == devs[4:8]
+    with pytest.raises(ValueError, match="need >= 16 devices"):
+        make_mesh(8, 2)
+
+
+def test_dedup_replica0_slices_leading_parts():
+    m2 = make_mesh(2, 2)
+    out = jnp.arange(4 * 3).reshape(4, 3)
+    np.testing.assert_array_equal(dedup_replica0(out, m2, 2), out[:2])
+    m1 = make_mesh(2, 1)
+    np.testing.assert_array_equal(dedup_replica0(out, m1, 2), out)
+
+
+# ----------------------------------------------------------------------------
+# (a) --replicas 1 bit-identity across strategy x wire
+# ----------------------------------------------------------------------------
+
+@pytest.mark.parametrize("strategy", ["padded", "shift", "ragged"])
+@pytest.mark.parametrize("wire", ["native", "bf16", "fp8", "int8"])
+def test_replicas1_bit_identical_to_1d(strategy, wire):
+    """fwd+bwd (loss_and_grad) through cfg.replicas=1 + make_mesh equals the
+    pre-replica construction BITWISE for every halo strategy x wire codec."""
+    g = synthetic_graph(n_nodes=80, avg_degree=5, n_feat=5, n_class=3, seed=32)
+    cfg = Config(model="graphsage", dropout=0.5, use_pp=True, norm="layer",
+                 n_train=g.n_train, lr=0.01, sampling_rate=0.5,
+                 halo_exchange=strategy, halo_wire=wire, replicas=1)
+    spec = ModelSpec("graphsage", (5, 8, 3), norm="layer", dropout=0.5,
+                     use_pp=True, train_size=g.n_train)
+    params, state = init_params(jax.random.key(9), spec)
+    params_np = _np_tree(params)
+    skey, dkey = jax.random.key(0), jax.random.key(1)
+    ep = jnp.uint32(1)
+
+    pid = partition_graph(g, 4, method="random", seed=3)
+    art = build_artifacts(g, pid)
+    outs = {}
+    for tag, mesh in (("new", make_mesh(4, cfg.replicas)),
+                      ("old", make_parts_mesh(4))):
+        _, fns, blk, tb = _setup(g, 4, cfg, spec, mesh, art=art)
+        assert fns.n_replicas == 1
+        p = place_replicated(params_np, mesh)
+        s = place_replicated(state, mesh)
+        loss, grads = fns.loss_and_grad(p, s, ep, blk, tb, skey, dkey)
+        outs[tag] = (np.asarray(loss), _np_tree(grads))
+
+    assert np.array_equal(outs["new"][0], outs["old"][0])   # bitwise
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 outs["new"][1], outs["old"][1])
+
+
+# ----------------------------------------------------------------------------
+# (b) --replicas 2 == mean of the two folded-seed single-replica runs
+# ----------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model,rate", [("graphsage", 1.0),
+                                        ("graphsage", 0.5),
+                                        # GAT: presence-masked edge softmax
+                                        # under per-replica sampled halos
+                                        ("gat", 0.5)])
+def test_replicas2_grad_is_mean_of_folded_single_runs(model, rate):
+    """4 parts x 2 replicas: the fused psum's gradient equals the mean of
+    two 1-D runs whose sample/dropout keys carry the replica fold — the
+    acceptance pin that the replica axis is exactly variance reduction,
+    never a change of estimator."""
+    g = synthetic_graph(n_nodes=80, avg_degree=5, n_feat=5, n_class=3, seed=32)
+    cfg = Config(model=model, dropout=0.5, use_pp=True, norm="layer",
+                 n_train=g.n_train, lr=0.01, sampling_rate=rate,
+                 heads=2 if model == "gat" else 1)
+    spec = ModelSpec(model, (5, 8, 3), norm="layer", dropout=0.5,
+                     use_pp=True, train_size=g.n_train,
+                     heads=2 if model == "gat" else 1)
+    params, state = init_params(jax.random.key(9), spec)
+    params_np = _np_tree(params)
+    skey, dkey = jax.random.key(0), jax.random.key(1)
+    ep = jnp.uint32(0)
+    pid = partition_graph(g, 4, method="random", seed=3)
+    art = build_artifacts(g, pid)
+
+    mesh2 = make_mesh(4, 2)
+    _, fns2, blk2, tb2 = _setup(g, 4, cfg.replace(replicas=2), spec, mesh2,
+                                art=art)
+    assert fns2.n_replicas == 2 and fns2.loss_and_grad is not None
+    p2 = place_replicated(params_np, mesh2)
+    s2 = place_replicated(state, mesh2)
+    l2, g2 = fns2.loss_and_grad(p2, s2, ep, blk2, tb2, skey, dkey)
+    l2, g2 = float(l2), _np_tree(g2)
+
+    mesh1 = make_parts_mesh(4)
+    _, fns1, blk1, tb1 = _setup(g, 4, cfg, spec, mesh1, art=art)
+    p1 = place_replicated(params_np, mesh1)
+    s1 = place_replicated(state, mesh1)
+    singles = []
+    for r in range(2):
+        lr_, gr_ = fns1.loss_and_grad(
+            p1, s1, ep, blk1, tb1,
+            jax.random.fold_in(skey, r), jax.random.fold_in(dkey, r))
+        singles.append((float(lr_), _np_tree(gr_)))
+    if rate < 1.0:
+        # the replicas really drew DIFFERENT samples (else the mean test
+        # would pass vacuously on identical draws)
+        assert abs(singles[0][0] - singles[1][0]) > 1e-9
+
+    np.testing.assert_allclose(l2, (singles[0][0] + singles[1][0]) / 2,
+                               rtol=1e-5, atol=1e-7)
+    gm = jax.tree.map(lambda a, b: (a + b) / 2, singles[0][1], singles[1][1])
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, rtol=1e-4, atol=1e-6), g2, gm)
+
+
+def test_replicas2_syncbn_trains():
+    """SyncBN under the replica axis: moments mean over BOTH axes (one fused
+    psum, whole_size x n_replicas) — pin that the estimator stays sane by
+    training to a decreasing loss and bit-consistent state across devices."""
+    g = sbm_graph(n_nodes=240, n_class=4, n_feat=8, p_in=0.08, p_out=0.004,
+                  seed=35)
+    cfg = Config(model="graphsage", dropout=0.1, use_pp=True, norm="batch",
+                 n_train=g.n_train, lr=0.01, sampling_rate=0.5, replicas=2)
+    spec = ModelSpec("graphsage", (8, 16, 4), norm="batch", dropout=0.1,
+                     use_pp=True, train_size=g.n_train)
+    mesh = make_mesh(4, 2)
+    _, fns, blk, tb = _setup(g, 4, cfg, spec, mesh)
+    params, state = init_params(jax.random.key(11), spec)
+    params = place_replicated(params, mesh)
+    state = place_replicated(state, mesh)
+    _, _, opt = init_training(cfg, spec, mesh)
+    key, dkey = jax.random.key(0), jax.random.key(1)
+    first = None
+    for e in range(25):
+        params, state, opt, loss = fns.train_step(
+            params, state, opt, jnp.uint32(e), blk, tb, key, dkey)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.8, (first, float(loss))
+    # BN running stats came out of a both-axes psum: finite and replicated
+    st = _np_tree(jax.device_get(state))
+    for leaf in jax.tree.leaves(st):
+        assert np.all(np.isfinite(leaf))
+
+
+@pytest.mark.quickgate
+def test_run_training_replicas2_e2e(tmp_path):
+    """Full run_training pass on the 2-D mesh: partitioning, precompute,
+    train loop, mesh-distributed eval (de-duplicated to replica 0),
+    checkpointing — the whole stack under --replicas 2."""
+    from bnsgcn_tpu.run import run_training
+    cfg = Config(dataset="sbm", n_partitions=4, replicas=2,
+                 model="graphsage", n_layers=2, n_hidden=16, n_epochs=12,
+                 log_every=5, sampling_rate=0.5, use_pp=True,
+                 eval_device="mesh",
+                 part_path=str(tmp_path / "parts"),
+                 ckpt_path=str(tmp_path / "ckpt"),
+                 results_path=str(tmp_path / "res"))
+    res = run_training(cfg, verbose=False)
+    assert np.isfinite(res.final_loss)
+    assert res.losses[-1] < res.losses[0]
+    assert res.best_val_acc > 0.5, res.best_val_acc
+
+
+# ----------------------------------------------------------------------------
+# (c) checkpoint invariance
+# ----------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_replica_invariant(tmp_path):
+    """Params/opt/BN state are replicated over BOTH mesh axes, so a 2-D
+    run's checkpoint is byte-for-byte a 1-D run's checkpoint: save from
+    replicas=2, restore into replicas=1 (and back) bitwise."""
+    g = synthetic_graph(n_nodes=80, avg_degree=5, n_feat=5, n_class=3, seed=32)
+    spec = ModelSpec("graphsage", (5, 8, 3), norm="layer", dropout=0.2,
+                     use_pp=True, train_size=g.n_train)
+    skey, dkey = jax.random.key(0), jax.random.key(1)
+    pid = partition_graph(g, 4, method="random", seed=3)
+    art = build_artifacts(g, pid)
+
+    def train2(mesh, cfg):
+        _, fns, blk, tb = _setup(g, 4, cfg, spec, mesh, art=art)
+        params, state = init_params(jax.random.key(9), spec)
+        params = place_replicated(params, mesh)
+        state = place_replicated(state, mesh)
+        _, _, opt = init_training(cfg, spec, mesh)
+        for e in range(2):
+            params, state, opt, _ = fns.train_step(
+                params, state, opt, jnp.uint32(e), blk, tb, skey, dkey)
+        return params, state, opt
+
+    base = Config(model="graphsage", dropout=0.2, use_pp=True, norm="layer",
+                  n_train=g.n_train, lr=0.01, sampling_rate=1.0)
+    # rate 1.0: both mesh shapes draw the identical (exact) plan, so even
+    # the trained states agree and the checkpoint comparison is exact
+    p2, s2, o2 = train2(make_mesh(4, 2), base.replace(replicas=2))
+    p1, s1, o1 = train2(make_parts_mesh(4), base)
+
+    path2 = str(tmp_path / "rep2.ckpt")
+    ckpt.save_checkpoint(path2, params=p2, opt_state=o2, bn_state=s2,
+                         epoch=1, best_acc=0.5, seed=7)
+    payload = ckpt.load_checkpoint(path2)
+    # restore into templates living on the OTHER mesh's host copies
+    rp, ro, rs = ckpt.restore_into(payload, _np_tree(p1), _np_tree(o1),
+                                   _np_tree(s1))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+                 _np_tree(p2), rp)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+                 _np_tree(o2), ro)
+    # and the restored host tree re-places cleanly onto a replica mesh
+    mesh2 = make_mesh(4, 2)
+    back = place_replicated(rp, mesh2)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+                 _np_tree(p2), _np_tree(back))
+
+
+# ----------------------------------------------------------------------------
+# pair_key: replica folding, distinctness grid, overflow guard (satellite)
+# ----------------------------------------------------------------------------
+
+def test_pair_key_replica_fold_first_contract():
+    """pair_key(base, e, p, j, replica=r) == pair_key(fold_in(base, r),
+    e, p, j): the contract that lets single-replica runs reproduce any
+    replica of a 2-D run by pre-folding the base key."""
+    base = jax.random.key(42)
+    e = jnp.uint32(3)
+    a = pair_key(base, e, 1, 2, replica=1)
+    b = pair_key(jax.random.fold_in(base, 1), e, 1, 2)
+    np.testing.assert_array_equal(jax.random.key_data(a),
+                                  jax.random.key_data(b))
+    # replica=None is a no-fold, NOT replica 0: the 1-D path keeps its
+    # historical key stream bit-identical
+    none_k = jax.random.key_data(pair_key(base, e, 1, 2))
+    zero_k = jax.random.key_data(pair_key(base, e, 1, 2, replica=0))
+    assert not np.array_equal(none_k, zero_k)
+
+
+def test_pair_key_distinct_on_exhaustive_grid():
+    """Distinct (replica, epoch, p, j) tuples never collide, exhaustively on
+    a small grid INCLUDING colliding scalar values (epoch==p==j etc.) — the
+    satellite pin that replica folding cannot alias any pre-existing pair
+    stream."""
+    base = jax.random.key(0)
+    seen = {}
+    for rep in [None, 0, 1, 2]:
+        for e in range(3):
+            for p in range(4):
+                for j in range(4):
+                    k = tuple(np.asarray(jax.random.key_data(
+                        pair_key(base, jnp.uint32(e), p, j, replica=rep)
+                    )).ravel().tolist())
+                    assert k not in seen, (
+                        f"key collision: {(rep, e, p, j)} vs {seen[k]}")
+                    seen[k] = (rep, e, p, j)
+    assert len(seen) == 4 * 3 * 4 * 4
+
+
+def test_pair_key_fold_guard_rejects_out_of_range():
+    base = jax.random.key(0)
+    e = jnp.uint32(0)
+    with pytest.raises(ValueError, match="replica=-1 outside"):
+        pair_key(base, e, 0, 1, replica=-1)
+    with pytest.raises(ValueError, match="epoch"):
+        pair_key(base, 2 ** 32, 0, 1)
+    with pytest.raises(ValueError, match="p="):
+        pair_key(base, e, -3, 1)
+    with pytest.raises(ValueError, match="j="):
+        pair_key(base, e, 0, 2 ** 40)
+    # boundary values are legal
+    pair_key(base, e, 0, 2 ** 32 - 1, replica=0)

@@ -128,6 +128,59 @@ def test_overlap_report_detects_hidden_exchange():
     assert overlap_from_events(make_trace()) is None
 
 
+def test_comm_by_axis_classifies_replica_groups():
+    """--by-axis breakdown (replica-axis observability): collectives carrying
+    HLO replica_groups are attributed to the mesh axis they reduce over in
+    the ('replicas','parts') device order (id = r*P + p, replicas outer);
+    attribute-stripped events fall back to the op-kind heuristic."""
+    from bnsgcn_tpu.utils.traceparse import classify_axis, comm_by_axis
+
+    P, R = 4, 2
+    # parts-axis groups: one consecutive run per replica row
+    assert classify_axis([[0, 1, 2, 3], [4, 5, 6, 7]], P, R) == "parts"
+    # replica-axis groups: stride-P pairs
+    assert classify_axis([[0, 4], [1, 5], [2, 6], [3, 7]], P, R) == "replicas"
+    # the fused gradient reduce spans the whole mesh
+    assert classify_axis([[0, 1, 2, 3, 4, 5, 6, 7]], P, R) == "replicas x parts"
+    # 1-D mesh: the full-mesh group IS the parts axis
+    assert classify_axis([[0, 1, 2, 3]], 4, 1) == "parts"
+    # misaligned consecutive ids (crossing a replica-row boundary) are not
+    # a parts-axis group
+    assert classify_axis([[2, 3, 4, 5]], P, R) == "unknown"
+    assert classify_axis([], P, R) == "unknown"
+
+    ev = [_meta(1, 0, "python"), _meta(1, 10, "dev0")]
+    a2a = _ev(1, 10, "all-to-all.1", 100.0, 30)
+    a2a["args"] = {"long_name": "all-to-all, replica_groups={{0,1,2,3},{4,5,6,7}}"}
+    ev.append(a2a)
+    ar = _ev(1, 10, "all-reduce.2", 200.0, 11)
+    ar["args"] = {"long_name": "all-reduce, replica_groups={{0,1,2,3,4,5,6,7}}"}
+    ev.append(ar)
+    # no replica_groups metadata: op-kind heuristic
+    ev.append(_ev(1, 10, "collective-permute.3", 300.0, 5))
+    ev.append(_ev(1, 10, "all-reduce.4", 400.0, 7))
+    # host (python) lane collectives are ignored as everywhere else
+    ev.append(_ev(1, 0, "all-to-all.9", 500.0, 999))
+    table = comm_by_axis(ev, P, R)
+    assert table["parts"]["exchange"] == 30 + 5
+    assert table["replicas x parts"]["reduce"] == 11 + 7
+    assert "replicas" not in table     # the fused trainer emits none
+
+    # 1-D mesh fallback: reduces land on 'parts'
+    table1 = comm_by_axis([_meta(1, 10, "dev0"),
+                           _ev(1, 10, "all-reduce.4", 0.0, 7)], 4, 1)
+    assert table1["parts"]["reduce"] == 7
+
+    # multi-lane traces reduce with the min-over-lanes estimator (same as
+    # program_cost): the waiter lane's 50 us span is rendezvous wait, the
+    # last arriver's 10 us is the true op cost — a raw cross-lane sum
+    # (60 us) would skew the axis comparison by straggler wait
+    ev3 = [_meta(1, 10, "dev0"), _meta(1, 11, "dev1")]
+    ev3.append(_ev(1, 10, "all-to-all.1", 100.0, 50))
+    ev3.append(_ev(1, 11, "all-to-all.1", 140.0, 10))
+    assert comm_by_axis(ev3, P, R)["parts"]["exchange"] == 10
+
+
 def test_step_comm_per_epoch_none_without_exchange_events(tmp_path):
     """A trace window holding train_step launches but NO device exchange
     events (observed when the step compiles inside the window on XLA:CPU)

@@ -31,6 +31,8 @@ trace) and prints the top op categories by device time for perf work.
 Usage:
   python tools/trace_comm.py --run                 # full cross-check table
   python tools/trace_comm.py --parse /tmp/hw_trace --breakdown
+  python tools/trace_comm.py --by-axis /tmp/hw_trace --parts 4 --replicas 2
+                                # parts-axis halo vs replica-axis grad traffic
 """
 
 from __future__ import annotations
@@ -49,9 +51,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # CLI and for tests/test_trace_comm.py.
 sys.path.insert(0, REPO)
 from bnsgcn_tpu.utils.traceparse import (  # noqa: E402,F401
-    EXCHANGE_PAT, REDUCE_PAT, HOST_PROGRAMS, load_trace_events,
-    _thread_names, attribute, overlap_from_events, overlap_report,
-    program_cost, step_comm_per_epoch)
+    EXCHANGE_PAT, REDUCE_PAT, HOST_PROGRAMS, classify_axis, comm_by_axis,
+    load_trace_events, _thread_names, attribute, overlap_from_events,
+    overlap_report, program_cost, step_comm_per_epoch)
 
 
 NON_OP_LANES = ("python", "Steps", "XLA Modules", "TC Overlay")
@@ -141,6 +143,14 @@ def main():
                     help="report whether the halo collective overlapped "
                          "interior SpMM compute in a --overlap split trace "
                          "(per-step exchange/interior/frontier/hidden ms)")
+    ap.add_argument("--by-axis", type=str, default="",
+                    help="group a trace's collective device time by mesh "
+                         "axis (parts-axis halo traffic vs the fused "
+                         "replicas x parts gradient reduce of a --replicas "
+                         "run); pass --parts / --replicas matching the "
+                         "traced mesh")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="replica-axis size of the traced mesh (--by-axis)")
     ap.add_argument("--wires", type=str, default="native,bf16,int8,fp8")
     ap.add_argument("--parts", type=int, default=8)
     ap.add_argument("--scale", type=float, default=0.05)
@@ -163,6 +173,25 @@ def main():
               f"frontier {rep['frontier_ms']:.3f} ms | "
               f"{rep['hidden_ms']:.3f} ms of the exchange hidden under "
               f"interior compute")
+        return 0
+
+    if args.by_axis:
+        events, path = load_trace_events(args.by_axis)
+        print(f"trace: {path}")
+        table = comm_by_axis(events, args.parts, args.replicas)
+        if not table:
+            print("no device collective events in the trace")
+            return 1
+        print(f"\ncollective device time by mesh axis "
+              f"(mesh {args.replicas} x {args.parts} replicas x parts):"
+              if args.replicas > 1 else
+              f"\ncollective device time by mesh axis ({args.parts} parts):")
+        print("| axis | exchange (s) | reduce (s) |")
+        print("|---|---|---|")
+        for axis in sorted(table):
+            k = table[axis]
+            print(f"| {axis} | {k.get('exchange', 0.0) / 1e6:.6f} "
+                  f"| {k.get('reduce', 0.0) / 1e6:.6f} |")
         return 0
 
     if args.parse:
