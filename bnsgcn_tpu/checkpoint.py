@@ -1,10 +1,18 @@
-"""Checkpointing with real resume.
+"""Checkpointing with real resume and an integrity chain.
 
 The reference is save-only — periodic `state_dict` snapshots and a final best
 model, no load path at all (train.py:428,452; SURVEY §5.4). This module is the
 capability upgrade SURVEY calls for: full training state (params, optimizer
 state, BN state, epoch counter, RNG seeds, best accuracy) round-trips through
 msgpack, so `--resume` continues a run bit-for-bit in expectation.
+
+Integrity chain (resilience subsystem): every file carries a magic header +
+sha256 over the payload, is fsync'd before the atomic rename (a preemption
+mid-save can tear the tmp file but never the published name), and
+`latest_valid_checkpoint` walks the periodic chain newest-to-oldest past any
+corrupt/torn/zero-byte file instead of crashing `--resume` — the divergence
+rollback (resilience.py) restores through the same walk. Pre-checksum files
+(no magic) still load, so old checkpoint dirs resume fine.
 
 Filenames mirror the reference's layout:
   {ckpt_path}/{graph_name}_p{rate:.2f}_{epoch}.ckpt   (periodic)
@@ -13,12 +21,22 @@ Filenames mirror the reference's layout:
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Any, Optional
 
 import jax
 import numpy as np
 from flax import serialization
+
+# header: 8-byte magic + 32-byte sha256(payload); everything after is msgpack
+_MAGIC = b"BNSCKPT1"
+_HDR = len(_MAGIC) + 32
+
+
+class CheckpointCorrupt(Exception):
+    """A checkpoint file failed integrity verification (zero-byte, torn,
+    checksum mismatch, or undecodable payload)."""
 
 
 def _to_host(tree):
@@ -43,13 +61,49 @@ def save_checkpoint(path: str, *, params, opt_state=None, bn_state=None,
     blob = serialization.msgpack_serialize(payload)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(hashlib.sha256(blob).digest())
         f.write(blob)
-    os.replace(tmp, path)          # atomic: no torn checkpoints on preemption
+        # fsync BEFORE the rename: os.replace is atomic in the namespace but
+        # not durable — after a preemption/power cut the published name must
+        # never point at partially-flushed pages
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:                            # fsync the dir so the rename itself is
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)    # durable
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass                        # not supported on every filesystem
 
 
 def load_checkpoint(path: str) -> dict[str, Any]:
+    """Read + verify a checkpoint. Raises CheckpointCorrupt on a zero-byte,
+    torn, or checksum-failing file (callers that walk the chain catch it;
+    `latest_valid_checkpoint` is the crash-proof entry). Files without the
+    magic header are pre-checksum checkpoints and load unverified."""
     with open(path, "rb") as f:
-        return serialization.msgpack_restore(f.read())
+        raw = f.read()
+    if not raw:
+        raise CheckpointCorrupt(f"{path}: zero-byte file")
+    if raw.startswith(_MAGIC):
+        if len(raw) <= _HDR:
+            raise CheckpointCorrupt(f"{path}: truncated header "
+                                    f"({len(raw)} bytes)")
+        digest, blob = raw[len(_MAGIC):_HDR], raw[_HDR:]
+        if hashlib.sha256(blob).digest() != digest:
+            raise CheckpointCorrupt(
+                f"{path}: payload checksum mismatch (torn or corrupt write)")
+    else:
+        blob = raw                  # legacy pre-checksum checkpoint
+    try:
+        return serialization.msgpack_restore(blob)
+    except Exception as ex:
+        raise CheckpointCorrupt(
+            f"{path}: undecodable payload ({type(ex).__name__}: {ex})") from ex
 
 
 def restore_into(payload: dict, params_template, opt_template=None,
@@ -110,6 +164,34 @@ def prune_checkpoints(cfg, keep: int):
 
 
 def latest_checkpoint(cfg) -> Optional[str]:
-    """Most recent periodic checkpoint for --resume."""
+    """Most recent periodic checkpoint path (unverified) — prefer
+    `latest_valid_checkpoint` anywhere the file will actually be loaded."""
     found = _periodic_ckpts(cfg)
     return os.path.join(cfg.ckpt_path, found[-1][1]) if found else None
+
+
+def latest_valid_checkpoint(cfg, log=None, before_epoch: Optional[int] = None
+                            ) -> Optional[tuple[str, dict]]:
+    """(path, payload) of the newest periodic checkpoint that verifies.
+
+    Walks the chain newest-to-oldest past corrupt/torn/zero-byte files —
+    a preempted writer or disk corruption costs at most the epochs since the
+    previous periodic save, never the run. Returns None when no valid file
+    exists. `before_epoch` restricts the walk to checkpoints strictly older
+    (divergence rollback must never restore a "future" file a previous run
+    left in the same dir). Multi-host: call on rank 0 only and broadcast the
+    result, same as the resume path in run.py."""
+    for ep, fn in reversed(_periodic_ckpts(cfg)):
+        if before_epoch is not None and ep >= before_epoch:
+            continue
+        path = os.path.join(cfg.ckpt_path, fn)
+        try:
+            return path, load_checkpoint(path)
+        except CheckpointCorrupt as ex:
+            if log:
+                log(f"[resilience] skipping corrupt checkpoint: {ex}")
+        except OSError as ex:
+            if log:
+                log(f"[resilience] skipping unreadable checkpoint "
+                    f"{fn}: {ex}")
+    return None

@@ -127,6 +127,19 @@ class Config:
                                         # build partition artifacts one part at a time
     feat_storage: str = "float32"       # on-disk feature dtype for streamed artifacts
                                         # ('bfloat16' halves papers100M-scale feature IO)
+    resilience: str = "on"              # 'on' (divergence rollback + preemption-
+                                        # safe shutdown + hung-step watchdog,
+                                        # resilience.py) | 'off' (bit-identical
+                                        # pre-resilience loop: no checks, no
+                                        # threads, no signal handlers)
+    inject: str = ""                    # deterministic fault injection:
+                                        # 'kind@E<epoch>,...' with kinds
+                                        # nan|sigterm|hang|ckpt-corrupt (env
+                                        # $BNSGCN_FAULT); CI proves every
+                                        # recovery path with it
+    resil_retries: int = 3              # divergence rollbacks (exponential
+                                        # backoff) before aborting with a
+                                        # diagnostic report
     cache_dir: str = ""                 # persistent dir for SpMM layout pickles
                                         # (content-addressed by hybrid_layout_key);
                                         # default from $BNSGCN_CACHE_DIR — point it at
@@ -222,6 +235,16 @@ def create_parser() -> argparse.ArgumentParser:
          choices=["auto", "always", "never"])
     both("feat-storage", type=str, default="float32",
          choices=["float32", "bfloat16"])
+    p.add_argument("--resilience", type=str, default="on",
+                   choices=["on", "off"],
+                   help="divergence rollback, preemption-safe checkpointing "
+                        "and the hung-step watchdog (off = the exact "
+                        "pre-resilience loop)")
+    p.add_argument("--inject", type=str,
+                   default=os.environ.get("BNSGCN_FAULT", ""),
+                   help="deterministic fault injection, e.g. "
+                        "'nan@E12,sigterm@E20,hang@E8,ckpt-corrupt@E10'")
+    both("resil-retries", type=int, default=3)
     both("cache-dir", type=str,
          default=os.environ.get("BNSGCN_CACHE_DIR", ""))
     both("edge-chunk", type=int, default=0)

@@ -12,9 +12,12 @@ every local device, and multi-host pods use `jax.distributed.initialize`
 
 from __future__ import annotations
 
+import os
 import random
+import sys
 import time
 
+from bnsgcn_tpu import resilience
 from bnsgcn_tpu.config import Config, parse_config
 from bnsgcn_tpu.run import prepare_partition, run_training
 
@@ -52,7 +55,30 @@ def main(argv=None):
         # partition_cli + --skip-partition to pre-distribute — README.md:116)
         multihost_utils.sync_global_devices("bnsgcn_partition_ready")
 
-    res = run_training(cfg)
+    # resilience exit-code contract (README "Fault tolerance"): preemption
+    # and divergence map to DISTINCT nonzero codes so a requeue wrapper can
+    # tell "relaunch with --resume" (75) from "needs human triage" (76);
+    # the hung-step watchdog exits 77 from inside resilience.py itself.
+    try:
+        res = run_training(cfg)
+    except resilience.PreemptedError as ex:
+        print(f"[resilience] {ex}")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # os._exit, not sys.exit: concurrent.futures joins non-daemon eval
+        # workers at interpreter shutdown, and a minutes-long in-flight host
+        # eval would overrun the preemption grace window — the platform's
+        # SIGKILL would then replace exit 75 with 137 and break the requeue
+        # wrapper's resume contract. The resumable checkpoint is already
+        # fsync'd; nothing else needs a clean unwind.
+        os._exit(resilience.EXIT_PREEMPTED)
+    except resilience.DivergenceError as ex:
+        print(f"[resilience] {ex}", file=sys.stderr)
+        sys.exit(resilience.EXIT_DIVERGED)
+    # machine-parseable summary for harnesses (fault-matrix e2e compares a
+    # resumed run's final loss against an uninterrupted one through this)
+    print("RESULT final_loss=%.9e best_val=%.6f test=%.6f"
+          % (res.final_loss, res.best_val_acc, res.test_acc))
     return res
 
 

@@ -1,0 +1,427 @@
+"""Self-healing training loop: the in-process resilience subsystem.
+
+At multi-hour full-graph scale (ROADMAP north star; Plexus, arXiv:2505.04083)
+preemption and divergence — not throughput — bound a run. Before this module
+the epoch loop had zero failure handling: a NaN loss trained to garbage
+silently, a SIGTERM (TPU maintenance / spot preemption) lost everything since
+the last periodic checkpoint, a torn `.ckpt` crashed `--resume`, and a hung
+collective was only caught by the `tools/tpu_watchdog*.sh` scripts polling
+from OUTSIDE the process. This module brings all four recoveries in-process:
+
+* **Divergence guard + rollback** — `run_training` checks the already-host-
+  fetched loss every step (free: the loop fetched it for `res.losses` anyway)
+  and a param-global-norm probe every `log_every`. On NaN/Inf it rolls
+  params/opt/BN state back to the newest VALID checkpoint (or the initial
+  state), re-folds the sampling/dropout key streams with a retry nonce —
+  BNS resamples per epoch (PAPER §3), so a diverged epoch is cheap to retry
+  under a fresh fold of the shared PRNG — and retries with exponential
+  backoff, aborting with a diagnostic report after `--resil-retries`.
+* **Preemption-safe shutdown** — SIGTERM/SIGINT set a flag the loop reads at
+  the step boundary; the loop writes a final resumable checkpoint, closes any
+  open profiler trace, and `main.py` exits with EXIT_PREEMPTED so a requeue
+  wrapper can relaunch with `--resume` and continue bit-for-bit.
+* **Hung-step watchdog** — a monitor thread with a deadline derived from the
+  rolling epoch-time mean; on expiry it dumps all-thread stacks and live-
+  array state to stderr and exits EXIT_WATCHDOG, replacing the shell
+  watchdogs' liveness probe for the training process itself.
+* **Deterministic fault injection** — `--inject nan@E12,sigterm@E20,hang@E8,
+  ckpt-corrupt@E10` (env $BNSGCN_FAULT) fires each fault at the named epoch's
+  step boundary, so every recovery path above is provable in CI on the CPU
+  mesh (tests/test_resilience*.py, tools/fault_matrix.sh), not just on
+  hardware.
+
+`--resilience off` constructs none of this: the loop is bit-identical to the
+pre-resilience code path (no extra device ops, no threads, no handlers).
+Multi-host runs also disable the manager for now — a coordinated abort across
+ranks is an open follow-up (ROADMAP) — but the checkpoint integrity chain
+(checkpoint.latest_valid_checkpoint) still protects rank 0's resume.
+
+Timing knobs are env vars, not flags, so CI can shrink them without widening
+the CLI surface:
+  BNSGCN_WATCHDOG_GRACE_S   deadline before the first step completes (600)
+  BNSGCN_WATCHDOG_FACTOR    deadline = max(MIN, FACTOR * rolling mean) (20)
+  BNSGCN_WATCHDOG_MIN_S     deadline floor after the first step (300)
+  BNSGCN_RETRY_BACKOFF_S    rollback backoff base, doubled per retry (1.0)
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from bnsgcn_tpu import checkpoint as ckpt
+
+# Distinct exit codes so a requeue wrapper (the tools/tpu_watchdog*.sh role,
+# now consolidated in-process) can tell retryable states apart:
+EXIT_PREEMPTED = 75   # EX_TEMPFAIL: resumable checkpoint written; relaunch
+                      # with --resume continues bit-for-bit
+EXIT_DIVERGED = 76    # rollback retries exhausted; diagnostic report printed
+EXIT_WATCHDOG = 77    # hung step: stacks + live arrays dumped to stderr
+
+FAULT_KINDS = ("nan", "sigterm", "hang", "ckpt-corrupt")
+
+
+class PreemptedError(Exception):
+    """Raised by run_training at a step boundary after SIGTERM/SIGINT: the
+    final resumable checkpoint is already on disk at `.ckpt_path`."""
+
+    def __init__(self, epoch: int, ckpt_path: str = ""):
+        self.epoch = epoch
+        self.ckpt_path = ckpt_path
+        super().__init__(
+            f"preempted at epoch {epoch}; resumable checkpoint at "
+            f"{ckpt_path or '<none>'} — relaunch with --resume")
+
+
+class DivergenceError(Exception):
+    """Raised when divergence rollback retries are exhausted; the message is
+    the full diagnostic report (also written next to the checkpoints)."""
+
+
+# ----------------------------------------------------------------------------
+# fault-injection plan
+# ----------------------------------------------------------------------------
+
+@dataclass
+class FaultPlan:
+    """Parsed `--inject` spec: kind -> sorted epochs, each fired once."""
+
+    faults: dict = field(default_factory=dict)   # kind -> set of epochs
+
+    @staticmethod
+    def parse(spec: str) -> "FaultPlan":
+        """Grammar: comma-separated `kind@E<epoch>` terms, e.g.
+        `nan@E12,sigterm@E20,hang@E8,ckpt-corrupt@E10`. Unknown kinds or
+        malformed terms raise — a typo'd injection silently not firing would
+        make a CI fault run vacuously green."""
+        plan = FaultPlan()
+        for term in filter(None, (t.strip() for t in spec.split(","))):
+            kind, sep, ep = term.partition("@")
+            if (not sep or not ep.startswith("E")
+                    or not ep[1:].isdigit()):
+                raise ValueError(
+                    f"bad --inject term {term!r}: expected kind@E<epoch> "
+                    f"(kinds: {', '.join(FAULT_KINDS)})")
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"unknown --inject fault {kind!r} "
+                    f"(kinds: {', '.join(FAULT_KINDS)})")
+            plan.faults.setdefault(kind, set()).add(int(ep[1:]))
+        return plan
+
+    def pop(self, kind: str, epoch: int) -> bool:
+        """True exactly once when `kind` is scheduled at `epoch`."""
+        eps = self.faults.get(kind)
+        if eps and epoch in eps:
+            eps.discard(epoch)
+            return True
+        return False
+
+    def empty(self) -> bool:
+        return not any(self.faults.values())
+
+
+# ----------------------------------------------------------------------------
+# hung-step watchdog
+# ----------------------------------------------------------------------------
+
+class _Watchdog(threading.Thread):
+    """Monitor thread: the loop calls `beat()` at each step boundary; if no
+    beat lands within the deadline (rolling-mean-derived once steps flow,
+    a grace period before that), dump all-thread stacks + live-array state
+    and exit EXIT_WATCHDOG. Daemon: never blocks normal interpreter exit."""
+
+    POLL_S = 0.25
+    ROLLING = 20
+
+    def __init__(self, log=print):
+        super().__init__(name="bnsgcn-watchdog", daemon=True)
+        self.log = log
+        self.grace_s = float(os.environ.get("BNSGCN_WATCHDOG_GRACE_S", 600))
+        self.factor = float(os.environ.get("BNSGCN_WATCHDOG_FACTOR", 20))
+        # floor of 300 s: epoch-boundary work that is slow-but-legit (a
+        # first-call eval compile, a multi-GB checkpoint fsync) must clear
+        # it — the quarry is hung collectives, which are minutes-to-forever
+        self.min_s = float(os.environ.get("BNSGCN_WATCHDOG_MIN_S", 300))
+        self._durs: list[float] = []
+        self._last_beat = time.monotonic()
+        self._epoch = -1
+        self._halt = threading.Event()
+        self._lock = threading.Lock()
+
+    def beat(self, epoch: int):
+        now = time.monotonic()
+        with self._lock:
+            if self._epoch >= 0:
+                self._durs.append(now - self._last_beat)
+                del self._durs[:-self.ROLLING]
+            self._epoch = epoch
+            self._last_beat = now
+
+    def touch(self):
+        """Reset the liveness clock WITHOUT recording a duration sample.
+
+        Called after legitimate long epoch-boundary work (mesh eval incl.
+        its first-call compile, checkpoint fsync, a rollback restore +
+        backoff) so that time never eats into the next step's deadline —
+        and so the rolling mean stays a pure step-time signal."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+
+    def deadline_s(self) -> float:
+        with self._lock:
+            if not self._durs:
+                return self.grace_s
+            mean = sum(self._durs) / len(self._durs)
+        return max(self.min_s, self.factor * mean)
+
+    def stop(self):
+        self._halt.set()
+
+    def run(self):
+        while not self._halt.wait(self.POLL_S):
+            idle = time.monotonic() - self._last_beat
+            deadline = self.deadline_s()
+            if idle <= deadline:
+                continue
+            self._dump(idle, deadline)
+            os._exit(EXIT_WATCHDOG)
+
+    def _dump(self, idle: float, deadline: float):
+        try:
+            sys.stderr.write(
+                "\n[watchdog] step hung: no step-boundary heartbeat for "
+                f"{idle:.1f}s (deadline {deadline:.1f}s, last epoch "
+                f"{self._epoch}); dumping stacks and exiting "
+                f"{EXIT_WATCHDOG}\n")
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+            try:
+                import jax
+                arrs = jax.live_arrays()
+                total = sum(getattr(a, "nbytes", 0) for a in arrs)
+                sys.stderr.write(
+                    f"[watchdog] {len(arrs)} live arrays, "
+                    f"{total / 2**20:.1f} MB on device\n")
+                for a in arrs[:8]:
+                    sys.stderr.write(
+                        f"[watchdog]   {a.dtype} {tuple(a.shape)}\n")
+            except Exception:
+                pass
+            sys.stderr.flush()
+        except Exception:
+            pass    # dumping must never mask the exit itself
+
+
+# ----------------------------------------------------------------------------
+# the manager run_training threads its loop through
+# ----------------------------------------------------------------------------
+
+class ResilienceManager:
+    """One per run_training call (single-host, `--resilience on`). Owns the
+    signal handlers, the watchdog, the fault plan, and the rollback state;
+    `close()` restores the process to its pre-run state so sequential
+    run_training calls (tests, bench sweeps) never leak handlers/threads."""
+
+    def __init__(self, cfg, log=print, start_epoch: int = 0,
+                 retry_nonce: int = 0):
+        self.cfg = cfg
+        self.log = log
+        self.start_epoch = start_epoch
+        self.plan = FaultPlan.parse(
+            cfg.inject or os.environ.get("BNSGCN_FAULT", ""))
+        if not self.plan.empty():
+            log(f"[resilience] fault plan armed: "
+                + ",".join(f"{k}@E{e}" for k, eps in
+                           sorted(self.plan.faults.items())
+                           for e in sorted(eps)))
+        self.retries = 0
+        self.nonce = retry_nonce        # cumulative rollback count; folds the
+                                        # sampling/dropout streams (persisted
+                                        # in ckpt extra so resume re-applies)
+        self.backoff_base = float(os.environ.get("BNSGCN_RETRY_BACKOFF_S", 1.0))
+        self.backoff_cap = 30.0
+        self.rollbacks: list[dict] = []     # surfaced on RunResult
+        self._preempt: Optional[str] = None
+        self._old_handlers: dict = {}
+        self._snapshot = None
+        self.watchdog = _Watchdog(log)
+
+    # -- lifecycle --
+
+    def start(self):
+        """Install signal handlers (main thread only — a worker-thread
+        run_training just skips them) and start the watchdog."""
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    self._old_handlers[sig] = signal.signal(sig, self._on_signal)
+                except (ValueError, OSError):
+                    pass
+        self.watchdog.start()
+        return self
+
+    def close(self):
+        self.watchdog.stop()
+        self.watchdog.join(timeout=2.0)
+        for sig, old in self._old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old_handlers.clear()
+
+    # -- preemption --
+
+    def _on_signal(self, signum, frame):
+        name = signal.Signals(signum).name
+        if self._preempt is not None:
+            # second signal: the operator (or the platform's kill escalation)
+            # wants out NOW — restore default handling and re-raise
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        self._preempt = name
+        # async-signal-safe enough: one line, flushed by the step boundary log
+        sys.stderr.write(
+            f"\n[resilience] {name} received: will checkpoint and exit "
+            f"{EXIT_PREEMPTED} at the next step boundary (send again to "
+            f"kill immediately)\n")
+
+    @property
+    def preempt_requested(self) -> Optional[str]:
+        return self._preempt
+
+    # -- divergence rollback --
+
+    def set_initial_snapshot(self, params_host, opt_host, state_host):
+        """Host copies of the fresh (or resumed) training state: the rollback
+        target when no valid checkpoint exists yet."""
+        self._snapshot = (params_host, opt_host, state_host)
+
+    def note_progress(self, epoch: int):
+        """A guard-verified periodic checkpoint landed at `epoch`, strictly
+        past the last rollback: that divergence is healed, so the retry /
+        backoff budget resets — a multi-day run surviving N independent
+        transients must not abort on the (N+1)th just because the counter
+        never forgot. The key-fold nonce is NOT reset: it must stay
+        monotonic for stream distinctness."""
+        if (self.retries and self.rollbacks
+                and epoch > self.rollbacks[-1]["epoch"]):
+            self.retries = 0
+
+    def rollback(self, epoch: int, loss_f: float, params_t, opt_t, state_t):
+        """Restore the last good state after a non-finite loss/param probe.
+
+        Returns (params_host, opt_host, state_host, restart_epoch, nonce):
+        host trees bitwise-equal the checkpoint they restore (pinned by
+        tests/test_resilience.py), the epoch to resume the loop at, and the
+        new retry nonce to re-fold the sampling/dropout keys with. Raises
+        DivergenceError with a diagnostic report once retries are exhausted.
+        """
+        self.retries += 1
+        limit = max(int(self.cfg.resil_retries), 0)
+        found = ckpt.latest_valid_checkpoint(self.cfg, log=self.log,
+                                             before_epoch=epoch)
+        if self.retries > limit:
+            raise DivergenceError(self._report(epoch, loss_f, found))
+        backoff = min(self.backoff_cap,
+                      self.backoff_base * (2 ** (self.retries - 1)))
+        if backoff > 0:
+            self.log(f"[resilience] backing off {backoff:.1f}s before retry "
+                     f"{self.retries}/{limit}")
+            time.sleep(backoff)
+        if found is not None:
+            path, payload = found
+            p, o, s = ckpt.restore_into(payload, params_t, opt_t, state_t)
+            restart = int(payload["epoch"]) + 1
+            src = os.path.basename(path)
+        else:
+            if self._snapshot is None:
+                raise DivergenceError(self._report(epoch, loss_f, None))
+            p, o, s = self._snapshot
+            restart = self.start_epoch
+            src = "<initial state>"
+        self.nonce += 1
+        self.rollbacks.append({"epoch": epoch, "restart": restart,
+                               "source": src, "nonce": self.nonce})
+        self.log(
+            f"[resilience] non-finite training state at epoch {epoch} "
+            f"(loss={loss_f}): rolled back to {src}, restarting at epoch "
+            f"{restart} with retry-nonce {self.nonce} folded into the "
+            f"sampling/dropout keys (retry {self.retries}/{limit})")
+        return p, o, s, restart, self.nonce
+
+    def _report(self, epoch: int, loss_f: float, found) -> str:
+        lines = [
+            f"divergence unrecovered after {self.retries - 1} rollback "
+            f"retr{'y' if self.retries == 2 else 'ies'} "
+            f"(--resil-retries {self.cfg.resil_retries}):",
+            f"  epoch {epoch}: loss={loss_f}",
+            f"  last valid checkpoint: "
+            f"{found[0] if found else '<none found>'}",
+            f"  rollback history: {self.rollbacks or '<none>'}",
+            "  likely causes: lr too high for this sampling rate, bad input "
+            "features, or fp8/int8 wire overflow — see README 'Fault "
+            "tolerance'",
+        ]
+        report = "\n".join(lines)
+        try:
+            os.makedirs(self.cfg.ckpt_path, exist_ok=True)
+            rp = os.path.join(self.cfg.ckpt_path,
+                              f"divergence_report_E{epoch}.txt")
+            with open(rp, "w") as f:
+                f.write(report + "\n")
+            report += f"\n  report written to {rp}"
+        except OSError:
+            pass
+        return report
+
+    # -- fault injection --
+
+    def fire_injections(self, epoch: int) -> dict:
+        """Apply this epoch's scheduled faults at the step boundary.
+
+        Returns {'nan': bool} — NaN poisoning is applied by the caller (it
+        owns the device params); the other kinds act here: `sigterm` raises
+        the real signal through the installed handler, `hang` blocks the main
+        thread so the watchdog path fires for real, and `ckpt-corrupt` tears
+        the newest periodic checkpoint to prove the fallback chain."""
+        out = {"nan": self.plan.pop("nan", epoch)}
+        if self.plan.pop("sigterm", epoch):
+            self.log(f"[inject] sigterm@E{epoch}")
+            signal.raise_signal(signal.SIGTERM)
+        if self.plan.pop("ckpt-corrupt", epoch):
+            latest = ckpt.latest_checkpoint(self.cfg)
+            if latest:
+                corrupt_file(latest)
+                self.log(f"[inject] ckpt-corrupt@E{epoch}: tore {latest}")
+            else:
+                self.log(f"[inject] ckpt-corrupt@E{epoch}: no checkpoint yet")
+        if self.plan.pop("hang", epoch):
+            self.log(f"[inject] hang@E{epoch}: blocking the step (watchdog "
+                     f"deadline {self.watchdog.deadline_s():.1f}s)")
+            while True:                 # the watchdog ends the process
+                time.sleep(3600)
+        if out["nan"]:
+            self.log(f"[inject] nan@E{epoch}: poisoning params")
+        return out
+
+
+def corrupt_file(path: str, keep_bytes: int = 64):
+    """Simulate a torn write: truncate to the first `keep_bytes` bytes and
+    flip them — the checkpoint keeps its checksum header but fails
+    verification, exactly the state a preemption mid-`os.replace`-era write
+    (or disk corruption) leaves behind."""
+    with open(path, "r+b") as f:
+        head = bytearray(f.read(keep_bytes))
+        for i in range(len(head)):
+            head[i] ^= 0xFF
+        f.seek(0)
+        f.write(head)
+        f.truncate(len(head))

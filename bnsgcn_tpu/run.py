@@ -16,8 +16,10 @@ measured by a compiled exchange-only microbench on identical inputs.
 
 from __future__ import annotations
 
+import math
 import os
 import shutil
+import sys
 import tempfile
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -29,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu import resilience
 from bnsgcn_tpu.config import Config
 from bnsgcn_tpu.data.artifacts import (PartitionArtifacts, build_artifacts,
                                        load_artifacts, save_artifacts)
@@ -39,8 +42,8 @@ from bnsgcn_tpu.evaluate import evaluate_induc, evaluate_mesh, evaluate_trans
 from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
 from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc
 from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns, init_training,
-                                local_part_ids, place_blocks, place_blocks_local,
-                                place_replicated)
+                                local_part_ids, param_global_norm, place_blocks,
+                                place_blocks_local, place_replicated)
 from bnsgcn_tpu.utils import traceparse
 from bnsgcn_tpu.utils.timers import EpochTimer, estimate_static_hbm, format_memory_stats
 
@@ -95,6 +98,9 @@ class RunResult:
     overlap_buckets: dict = field(default_factory=dict)
     # --overlap split: trace-derived per-step exchange/interior/frontier/
     # hidden ms means (EpochTimer.bucket_means); empty for fused runs
+    rollbacks: list = field(default_factory=list)
+    # divergence recoveries this run performed: [{'epoch', 'restart',
+    # 'source', 'nonce'}, ...] (resilience.ResilienceManager.rollbacks)
 
 
 def run_training(cfg: Config, g: Optional[Graph] = None,
@@ -181,8 +187,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         import hashlib
 
         from bnsgcn_tpu.trainer import ell_layout_key, hybrid_layout_key
-        from bnsgcn_tpu.utils.diskcache import atomic_dump, try_load
+        from bnsgcn_tpu.utils.diskcache import (atomic_dump, sweep_stale_tmp,
+                                                try_load)
         os.makedirs(cfg.cache_dir, exist_ok=True)
+        # a crashed/preempted writer mid-atomic_dump leaves a torn *.tmp —
+        # sweep them on open so the dir can't accumulate garbage
+        sweep_stale_tmp(cfg.cache_dir, log)
         gname = cfg.graph_name or cfg.derive_graph_name()
         # content-address the PARTITION, not just its name: layouts are a
         # pure function of (src, dst) — a re-partition under the same graph
@@ -325,24 +335,35 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     params, state, opt_state = init_training(cfg, spec, mesh, seed=seed, dtype=dtype)
     start_epoch, best_acc, best_params = 0, 0.0, None
+    retry_nonce = 0     # cumulative divergence-rollback count: folds the
+                        # sampling/dropout key streams (resilience.py) and
+                        # round-trips through checkpoint extra so a resumed
+                        # run continues the post-rollback streams bit-for-bit
     if cfg.resume and multi_host:
-        # rank 0 reads the checkpoint; everything restored must be broadcast
-        # so all processes drive the SPMD loop over the same epoch range
+        # rank 0 reads (and integrity-validates) the checkpoint; everything
+        # restored must be broadcast so all processes drive the SPMD loop
+        # over the same epoch range
         from jax.experimental import multihost_utils
         payload = None
         if is_rank0:
-            latest = ckpt.latest_checkpoint(cfg)
-            if latest:
-                payload = ckpt.load_checkpoint(latest)
-        # broadcast [next_epoch, saved_seed] together: the resumed run must
-        # continue the checkpoint's BNS-sampling/dropout streams, and every
-        # process must agree on them (shared-PRNG invariant)
-        have, saved_seed = (int(x) for x in multihost_utils.broadcast_one_to_all(
-            np.asarray([0 if payload is None else int(payload["epoch"]) + 1,
-                        seed if payload is None else int(payload.get("seed", seed))],
-                       dtype=np.int64)))
+            found = ckpt.latest_valid_checkpoint(cfg, log=log)
+            if found:
+                payload = found[1]
+        # broadcast [next_epoch, saved_seed, retry_nonce] together: the
+        # resumed run must continue the checkpoint's BNS-sampling/dropout
+        # streams, and every process must agree on them (shared-PRNG
+        # invariant)
+        have, saved_seed, saved_nonce = (
+            int(x) for x in multihost_utils.broadcast_one_to_all(
+                np.asarray(
+                    [0 if payload is None else int(payload["epoch"]) + 1,
+                     seed if payload is None else int(payload.get("seed", seed)),
+                     0 if payload is None else int(
+                         (payload.get("extra") or {}).get("retry_nonce", 0))],
+                    dtype=np.int64)))
         if int(have) > 0:
             seed = saved_seed
+            retry_nonce = saved_nonce
             host = ckpt.restore_into(payload, jax.device_get(params),
                                      jax.device_get(opt_state),
                                      jax.device_get(state)) if is_rank0 else (
@@ -363,8 +384,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             if is_rank0 and best_acc > 0:
                 fpath = ckpt.final_path(cfg)
                 if os.path.exists(fpath):
-                    fp = ckpt.load_checkpoint(fpath)
-                    if abs(float(fp.get("best_acc", -1.0)) - best_acc) < 1e-9:
+                    try:
+                        fp = ckpt.load_checkpoint(fpath)
+                    except ckpt.CheckpointCorrupt as ex:
+                        log(f"[resilience] final checkpoint unusable ({ex}); "
+                            f"restarting best tracking")
+                        fp = None
+                    if fp is not None and abs(
+                            float(fp.get("best_acc", -1.0)) - best_acc) < 1e-9:
                         recovered = np.int64(1)
             recovered = int(multihost_utils.broadcast_one_to_all(recovered))
             if best_acc > 0 and recovered:
@@ -375,9 +402,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 best_acc = 0.0
             log(f"Resumed (broadcast from rank 0) at epoch {start_epoch}")
     elif cfg.resume:
-        latest = ckpt.latest_checkpoint(cfg)
-        if latest:
-            payload = ckpt.load_checkpoint(latest)
+        # latest_valid_checkpoint walks past corrupt/torn files: a bad
+        # newest checkpoint costs the epochs since the previous periodic
+        # save instead of crashing the resume
+        found = ckpt.latest_valid_checkpoint(cfg, log=log)
+        if found:
+            latest, payload = found
             p, o, s = ckpt.restore_into(payload, jax.device_get(params),
                                         jax.device_get(opt_state),
                                         jax.device_get(state))
@@ -390,6 +420,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # launch, but a resumed run must continue the saved sampling and
             # dropout streams (checkpoint.py's round-trip contract)
             seed = int(payload.get("seed", seed))
+            retry_nonce = int((payload.get("extra") or {})
+                              .get("retry_nonce", 0))
             log(f"Resumed from {latest} at epoch {start_epoch}")
             # recover the best-so-far params (final ckpt) so a resumed run that
             # never beats the old best still saves/evaluates a best model; the
@@ -398,7 +430,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             fpath = ckpt.final_path(cfg)
             recovered = False
             if best_acc > 0 and os.path.exists(fpath):
-                fp = ckpt.load_checkpoint(fpath)
+                try:
+                    fp = ckpt.load_checkpoint(fpath)
+                except ckpt.CheckpointCorrupt as ex:
+                    log(f"[resilience] final checkpoint unusable ({ex}); "
+                        f"restarting best tracking")
+                    fp = {}
                 if abs(float(fp.get("best_acc", -1.0)) - best_acc) < 1e-9:
                     best_params = ckpt.restore_into(fp, jax.device_get(params))[0]
                     recovered = True
@@ -408,8 +445,43 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     # Both keys derive from cfg.seed: every process of a multi-host run MUST
     # agree on the sampling key or the shared-PRNG BNS exchange desyncs
     # (main.py broadcasts the randomized seed from process 0).
-    sample_key = jax.random.key(seed)
-    drop_key = jax.random.key(seed + 1)
+    base_sample_key = jax.random.key(seed)
+    base_drop_key = jax.random.key(seed + 1)
+
+    def _fold_keys(nonce: int):
+        """Retry-nonce fold of the sampling/dropout streams: after the n-th
+        divergence rollback every subsequent epoch draws from fold_in(base,
+        n), so the retried epoch resamples its BNS boundary sets (PAPER §3:
+        a diverged epoch is cheap to retry under a fresh fold) instead of
+        deterministically re-diverging. nonce 0 — every run that never
+        rolled back — is the historical keys, bit-identical."""
+        if nonce:
+            return (jax.random.fold_in(base_sample_key, nonce),
+                    jax.random.fold_in(base_drop_key, nonce))
+        return base_sample_key, base_drop_key
+
+    sample_key, drop_key = _fold_keys(retry_nonce)
+
+    # ---- resilience subsystem (divergence rollback, preemption-safe
+    # shutdown, hung-step watchdog, fault injection) ----
+    resil = None
+    if cfg.resilience == "on" and not multi_host:
+        resil = resilience.ResilienceManager(cfg, log, start_epoch=start_epoch,
+                                             retry_nonce=retry_nonce)
+        # host snapshot of the fresh/resumed state: the rollback target
+        # until the first periodic checkpoint exists
+        resil.set_initial_snapshot(jax.device_get(params),
+                                   jax.device_get(opt_state),
+                                   jax.device_get(state))
+        resil.start()
+    elif cfg.resilience == "on":
+        log("[resilience] multi-host run: in-process divergence rollback/"
+            "watchdog disabled (coordinated abort across ranks is a ROADMAP "
+            "follow-up); the checkpoint integrity chain still protects "
+            "rank-0 resume")
+    if resil is None and (cfg.inject or os.environ.get("BNSGCN_FAULT")):
+        log("[resilience] WARNING: --inject is armed but the resilience "
+            "loop is disabled here — no fault will fire")
 
     os.makedirs(cfg.ckpt_path, exist_ok=True)
     os.makedirs(cfg.results_path, exist_ok=True)
@@ -467,147 +539,270 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         trace_dir = auto_trace_dir
     comm_traced = reduce_traced = None
 
+    def _eval_job(e, thunk):
+        """Async host eval wrapper: a raise inside the thread must NOT kill
+        training a full log_every later when .result() re-raises — label the
+        failure with the epoch it belongs to and let the consumer log it and
+        keep training (best-acc tracking just skips that sample)."""
+        try:
+            return e, thunk(), None
+        except Exception as ex:     # noqa: BLE001 — every eval failure is soft
+            return e, None, ex
+
+    def _drain_eval(fut):
+        """(params, acc) from a finished eval future, or None on failure."""
+        e, out, err = fut.result()
+        if err is not None:
+            log(f"[resilience] host eval for epoch {e} failed "
+                f"({type(err).__name__}: {err}); continuing training")
+            return None
+        return out
+
     loss = jnp.zeros(())
-    for epoch in range(start_epoch, cfg.n_epochs):
-        if trace_dir and epoch == prof_start and prof_stop > prof_start:
-            jax.profiler.start_trace(trace_dir)
-            tracing = True
-        t0 = time.perf_counter()
-        params, state, opt_state, loss = fns.train_step(
-            params, state, opt_state, jnp.uint32(epoch), blk, tables,
-            sample_key, drop_key)
-        loss.block_until_ready()
-        dt = time.perf_counter() - t0
-        if tracing and epoch >= prof_stop:
-            jax.profiler.stop_trace()
+    loss_f = 0.0
+    trace_done = False          # one trace window per run, even across rollbacks
+    loss_base = start_epoch     # epoch of res.losses[0]: a rollback behind the
+                                # resume point (newer ckpts all corrupt) rebases
+                                # the list instead of corrupting its indexing
+    epoch = start_epoch
+    # The loop is a `while` so the divergence guard can move `epoch`
+    # BACKWARD (rollback to the last good checkpoint, resilience.py); with
+    # --resilience off no hook below fires and the schedule is exactly the
+    # historical `for epoch in range(start_epoch, n_epochs)`.
+    try:
+        while epoch < cfg.n_epochs:
+            if resil is not None:
+                resil.watchdog.beat(epoch)
+                # deterministic fault injection at the step boundary
+                # (--inject / $BNSGCN_FAULT); 'nan' poisons the params so
+                # the divergence shows up through the REAL loss path
+                if resil.fire_injections(epoch)["nan"]:
+                    params = jax.tree.map(
+                        lambda x: x * jnp.nan
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                        params)
+            if (trace_dir and epoch == prof_start and prof_stop > prof_start
+                    and not tracing and not trace_done):
+                jax.profiler.start_trace(trace_dir)
+                tracing = True
+            t0 = time.perf_counter()
+            params, state, opt_state, loss = fns.train_step(
+                params, state, opt_state, jnp.uint32(epoch), blk, tables,
+                sample_key, drop_key)
+            loss.block_until_ready()
+            dt = time.perf_counter() - t0
+            loss_f = float(loss)
+
+            # ---- divergence guard: free loss check every step (the loop
+            # fetched it for res.losses anyway) + param-norm probe every
+            # log_every; rollback BEFORE the checkpoint write below so a
+            # non-finite state can never become "last good" ----
+            bad = resil is not None and not math.isfinite(loss_f)
+            if (resil is not None and not bad
+                    and (epoch + 1) % cfg.log_every == 0):
+                bad = not math.isfinite(float(param_global_norm(params)))
+            if bad:
+                p_h, o_h, s_h, restart, retry_nonce = resil.rollback(
+                    epoch, loss_f, jax.device_get(params),
+                    jax.device_get(opt_state), jax.device_get(state))
+                params = place_replicated(p_h, mesh)
+                opt_state = place_replicated(o_h, mesh)
+                state = place_replicated(s_h, mesh)
+                sample_key, drop_key = _fold_keys(retry_nonce)
+                # retried epochs get re-recorded on the healthy pass
+                if restart < loss_base:
+                    res.losses.clear()
+                    loss_base = restart
+                else:
+                    del res.losses[restart - loss_base:]
+                resil.watchdog.touch()      # restore+backoff was boundary
+                epoch = restart             # work, not step time
+                continue
+
+            if tracing and epoch >= prof_stop:
+                jax.profiler.stop_trace()
+                tracing = False
+                trace_done = True
+                if cfg.profile_dir:
+                    log(f"profiler trace written to {cfg.profile_dir}")
+                # load the trace ONCE; both the Comm/Reduce attribution and
+                # the overlap report parse the same event list
+                try:
+                    trace_events, _ = traceparse.load_trace_events(trace_dir)
+                except Exception:
+                    trace_events = None
+                parsed = (traceparse.step_comm_from_events(trace_events)
+                          if trace_events is not None else None)
+                if parsed is not None:
+                    comm_traced, reduce_traced = parsed[0], parsed[1]
+                    # drop the microbench samples recorded so far so the
+                    # printed means are purely the traced in-step numbers;
+                    # seed one sample immediately — the window-closing epoch
+                    # itself is excluded from record(), and a log line firing
+                    # on it would otherwise print an empty (0.0) mean
+                    timer.comm_dur.clear()
+                    timer.reduce_dur.clear()
+                    timer.comm_dur.append(comm_traced)
+                    timer.reduce_dur.append(reduce_traced)
+                if fns.overlap == "split":
+                    # --overlap split observability: per-step phase buckets +
+                    # whether the collective ran under interior compute
+                    try:
+                        rep = (traceparse.overlap_from_events(trace_events)
+                               if trace_events is not None else None)
+                    except Exception:
+                        rep = None
+                    if rep is not None:
+                        for k in ("exchange_ms", "interior_ms", "frontier_ms",
+                                  "hidden_ms"):
+                            timer.record_bucket(k, rep[k])
+                        log("overlap[traced]: exchange {exchange_ms:.3f} ms | "
+                            "interior {interior_ms:.3f} ms | frontier "
+                            "{frontier_ms:.3f} ms | hidden {hidden_ms:.3f} ms "
+                            "per step — collective overlapped interior "
+                            "compute: {verdict}".format(
+                                verdict="YES" if rep["overlapped"] else "NO",
+                                **{k: rep[k] for k in rep}))
+                    else:
+                        log("overlap[traced]: no interior/frontier scope "
+                            "spans in the trace window (tools/trace_comm.py "
+                            "--overlap-check <dir> on a --profile-dir trace "
+                            "gives the full report)")
+                if auto_trace_dir:
+                    shutil.rmtree(auto_trace_dir, ignore_errors=True)
+
+            if comm_traced is not None:
+                comm_t = comm_traced
+            elif epoch == timer.warmup or (epoch + 1) % cfg.log_every == 0:
+                # comm microbench: exchange-only programs at each real layer
+                # width, x2 for the backward (transposed) exchange
+                comm_t = 0.0
+                for w in exch_widths:
+                    t1 = time.perf_counter()
+                    fns.exchange_only(blk, tables, jnp.uint32(epoch),
+                                      sample_key, width=w).block_until_ready()
+                    comm_t += (time.perf_counter() - t1) * 2
+            # epochs inside the trace window carry profiler-collection
+            # overhead in dt — exclude them from the reported means like
+            # warmup epochs (same rule as bench.py, whose traced runs are
+            # tagged profiled-diagnostic and never update best_known)
+            if not (trace_dir and prof_start <= epoch <= prof_stop):
+                timer.record(epoch, dt, comm_t,
+                             reduce_traced if reduce_traced is not None else 0.0)
+            res.losses.append(loss_f)
+
+            if (epoch + 1) % cfg.log_every == 0:
+                mt, mc, mr = timer.means()
+                # [traced]: per-epoch in-step collective time attributed from
+                # the profiler window (the reference's comm_timer equivalent).
+                # [sampled]: the exchange-only microbench at the training
+                # compute dtype, which overstates quantized wires (dispatch-
+                # dominated; measured up to 26x for int8) — printed only
+                # until the trace window closes or under --no-comm-trace.
+                tag = "[traced]" if comm_traced is not None else "[sampled]"
+                log("Process 000 | Epoch {:05d} | Time(s) {:.4f} | Comm(s) "
+                    "{:.4f} {} | Reduce(s) {:.4f} | Loss {:.4f}".format(
+                        epoch, mt, mc, tag, mr, loss_f))
+
+            wrote_ckpt = False
+            if (epoch + 1) % cfg.log_every == 0 and is_rank0:
+                # periodic checkpoint regardless of eval, so --no-eval runs
+                # resume too; rank 0 only (reference train.py:427-428)
+                ckpt.save_checkpoint(ckpt.periodic_path(cfg, epoch),
+                                     params=params, opt_state=opt_state,
+                                     bn_state=state, epoch=epoch,
+                                     best_acc=best_acc, seed=seed,
+                                     extra={"retry_nonce": retry_nonce})
+                ckpt.prune_checkpoints(cfg, cfg.keep_ckpt)
+                wrote_ckpt = True
+            if mesh_eval and (epoch + 1) % cfg.log_every == 0:
+                fns_e, blk_e, tf_e, art_e = eval_val
+                modes = ("val",) if cfg.inductive else ("val", "test")
+                accs = evaluate_mesh("Epoch %05d" % epoch, fns_e.eval_forward,
+                                     params, state, blk_e, tf_e, art_e, modes,
+                                     result_file)
+                if accs["val"] > best_acc:
+                    best_acc, best_params = accs["val"], jax.device_get(params)
+            elif cfg.eval and is_rank0 and (epoch + 1) % cfg.log_every == 0:
+                if pending is not None:
+                    done = _drain_eval(pending)
+                    if done is not None and done[1] > best_acc:
+                        best_acc, best_params = done[1], done[0]
+                p_host = jax.device_get(params)
+                s_host = jax.device_get(state)
+                # bind the epoch label like the params: the thread may run
+                # after the loop has advanced, and a late-bound `epoch`
+                # mislabels the eval line (observed as an "Epoch 00020" eval
+                # in a log_every=10 run)
+                if cfg.inductive:
+                    pending = pool.submit(
+                        _eval_job, epoch,
+                        lambda p=p_host, s=s_host, e=epoch: (p, evaluate_induc(
+                            "Epoch %05d" % e, p, s, spec, val_g, "val",
+                            result_file)))
+                else:
+                    pending = pool.submit(
+                        _eval_job, epoch,
+                        lambda p=p_host, s=s_host, e=epoch: (p, evaluate_trans(
+                            "Epoch %05d" % e, p, s, spec, val_g,
+                            result_file)[0]))
+
+            if resil is not None and (epoch + 1) % cfg.log_every == 0:
+                if wrote_ckpt:
+                    # a guard-verified checkpoint strictly past the last
+                    # rollback heals the divergence retry budget
+                    resil.note_progress(epoch)
+                # checkpoint fsync + (mesh) eval — incl. the eval compile on
+                # its first call — are epoch-boundary work: reset the
+                # liveness clock so they never eat into the next step's
+                # watchdog deadline
+                resil.watchdog.touch()
+
+            # ---- preemption-safe shutdown: the SIGTERM/SIGINT flag is read
+            # at the step boundary only — mid-step device state is never
+            # torn. The resumable checkpoint carries seed + retry nonce, so
+            # --resume continues the exact sampling/dropout streams. ----
+            if resil is not None and resil.preempt_requested:
+                ppath = ckpt.periodic_path(cfg, epoch)
+                if is_rank0 and not wrote_ckpt:
+                    ckpt.save_checkpoint(ppath, params=params,
+                                         opt_state=opt_state, bn_state=state,
+                                         epoch=epoch, best_acc=best_acc,
+                                         seed=seed,
+                                         extra={"retry_nonce": retry_nonce})
+                    ckpt.prune_checkpoints(cfg, cfg.keep_ckpt)
+                log(f"[resilience] {resil.preempt_requested} honored at the "
+                    f"epoch-{epoch} step boundary: resumable checkpoint at "
+                    f"{ppath}")
+                raise resilience.PreemptedError(epoch, ppath)
+            epoch += 1
+    finally:
+        # trace-window leak fix: a crash/preemption anywhere in the loop
+        # (including the normal shorter-than-prof_stop ending) must not
+        # leave a dangling profiler session or the auto temp dir behind
+        if tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
             tracing = False
             if cfg.profile_dir:
                 log(f"profiler trace written to {cfg.profile_dir}")
-            # load the trace ONCE; both the Comm/Reduce attribution and the
-            # overlap report parse the same event list
-            try:
-                trace_events, _ = traceparse.load_trace_events(trace_dir)
-            except Exception:
-                trace_events = None
-            parsed = (traceparse.step_comm_from_events(trace_events)
-                      if trace_events is not None else None)
-            if parsed is not None:
-                comm_traced, reduce_traced = parsed[0], parsed[1]
-                # drop the microbench samples recorded so far so the
-                # printed means are purely the traced in-step numbers;
-                # seed one sample immediately — the window-closing epoch
-                # itself is excluded from record(), and a log line firing
-                # on it would otherwise print an empty (0.0) mean
-                timer.comm_dur.clear()
-                timer.reduce_dur.clear()
-                timer.comm_dur.append(comm_traced)
-                timer.reduce_dur.append(reduce_traced)
-            if fns.overlap == "split":
-                # --overlap split observability: per-step phase buckets +
-                # whether the collective actually ran under interior compute
-                try:
-                    rep = (traceparse.overlap_from_events(trace_events)
-                           if trace_events is not None else None)
-                except Exception:
-                    rep = None
-                if rep is not None:
-                    for k in ("exchange_ms", "interior_ms", "frontier_ms",
-                              "hidden_ms"):
-                        timer.record_bucket(k, rep[k])
-                    log("overlap[traced]: exchange {exchange_ms:.3f} ms | "
-                        "interior {interior_ms:.3f} ms | frontier "
-                        "{frontier_ms:.3f} ms | hidden {hidden_ms:.3f} ms "
-                        "per step — collective overlapped interior compute: "
-                        "{verdict}".format(
-                            verdict="YES" if rep["overlapped"] else "NO",
-                            **{k: rep[k] for k in rep}))
-                else:
-                    log("overlap[traced]: no interior/frontier scope spans "
-                        "in the trace window (tools/trace_comm.py "
-                        "--overlap-check <dir> on a --profile-dir trace "
-                        "gives the full report)")
-            if auto_trace_dir:
-                shutil.rmtree(auto_trace_dir, ignore_errors=True)
-
-        if comm_traced is not None:
-            comm_t = comm_traced
-        elif epoch == timer.warmup or (epoch + 1) % cfg.log_every == 0:
-            # comm microbench: exchange-only programs at each real layer width,
-            # x2 for the backward (transposed) exchange
-            comm_t = 0.0
-            for w in exch_widths:
-                t1 = time.perf_counter()
-                fns.exchange_only(blk, tables, jnp.uint32(epoch), sample_key,
-                                  width=w).block_until_ready()
-                comm_t += (time.perf_counter() - t1) * 2
-        # epochs inside the trace window carry profiler-collection overhead
-        # in dt — exclude them from the reported means like warmup epochs
-        # (same rule as bench.py, whose traced runs are tagged
-        # profiled-diagnostic and never update best_known)
-        if not (trace_dir and prof_start <= epoch <= prof_stop):
-            timer.record(epoch, dt, comm_t,
-                         reduce_traced if reduce_traced is not None else 0.0)
-        res.losses.append(float(loss))
-
-        if (epoch + 1) % cfg.log_every == 0:
-            mt, mc, mr = timer.means()
-            # [traced]: per-epoch in-step collective time attributed from
-            # the profiler window (the reference's comm_timer equivalent).
-            # [sampled]: the exchange-only microbench at the training
-            # compute dtype, which overstates quantized wires (dispatch-
-            # dominated; measured up to 26x for int8) — printed only until
-            # the trace window closes or under --no-comm-trace.
-            tag = "[traced]" if comm_traced is not None else "[sampled]"
-            log("Process 000 | Epoch {:05d} | Time(s) {:.4f} | Comm(s) {:.4f} "
-                "{} | Reduce(s) {:.4f} | Loss {:.4f}".format(
-                    epoch, mt, mc, tag, mr, float(loss)))
-
-        if (epoch + 1) % cfg.log_every == 0 and is_rank0:
-            # periodic checkpoint regardless of eval, so --no-eval runs resume
-            # too; rank 0 only (reference train.py:427-428)
-            ckpt.save_checkpoint(ckpt.periodic_path(cfg, epoch),
-                                 params=params, opt_state=opt_state, bn_state=state,
-                                 epoch=epoch, best_acc=best_acc, seed=seed)
-            ckpt.prune_checkpoints(cfg, cfg.keep_ckpt)
-        if mesh_eval and (epoch + 1) % cfg.log_every == 0:
-            fns_e, blk_e, tf_e, art_e = eval_val
-            modes = ("val",) if cfg.inductive else ("val", "test")
-            accs = evaluate_mesh("Epoch %05d" % epoch, fns_e.eval_forward,
-                                 params, state, blk_e, tf_e, art_e, modes,
-                                 result_file)
-            if accs["val"] > best_acc:
-                best_acc, best_params = accs["val"], jax.device_get(params)
-        elif cfg.eval and is_rank0 and (epoch + 1) % cfg.log_every == 0:
-            if pending is not None:
-                p_eval, acc = pending.result()
-                if acc > best_acc:
-                    best_acc, best_params = acc, p_eval
-            p_host = jax.device_get(params)
-            s_host = jax.device_get(state)
-            # bind the epoch label like the params: the thread may run after
-            # the loop has advanced, and a late-bound `epoch` mislabels the
-            # eval line (observed as an "Epoch 00020" eval in a log_every=10
-            # run)
-            if cfg.inductive:
-                pending = pool.submit(
-                    lambda p=p_host, s=s_host, e=epoch: (p, evaluate_induc(
-                        "Epoch %05d" % e, p, s, spec, val_g, "val", result_file)))
-            else:
-                pending = pool.submit(
-                    lambda p=p_host, s=s_host, e=epoch: (p, evaluate_trans(
-                        "Epoch %05d" % e, p, s, spec, val_g, result_file)[0]))
-
-    if tracing:
-        # run ended inside the window (epoch loop shorter than prof_stop)
-        jax.profiler.stop_trace()
-        if cfg.profile_dir:
-            log(f"profiler trace written to {cfg.profile_dir}")
         if auto_trace_dir:
             shutil.rmtree(auto_trace_dir, ignore_errors=True)
+        if resil is not None:
+            res.rollbacks = list(resil.rollbacks)
+            resil.close()
+        if sys.exc_info()[0] is not None:
+            # propagate without waiting on a queued eval. An in-flight eval
+            # still runs in its (non-daemon) worker; the CLI preemption path
+            # therefore ends with os._exit in main.py so the exit-75
+            # contract can't be stalled past the platform's grace window
+            pool.shutdown(wait=False, cancel_futures=True)
     if pending is not None:
-        p_eval, acc = pending.result()
-        if acc > best_acc:
-            best_acc, best_params = acc, p_eval
+        done = _drain_eval(pending)
+        if done is not None and done[1] > best_acc:
+            best_acc, best_params = done[1], done[0]
     pool.shutdown(wait=True)
 
     res.epoch_time, res.comm_time, res.reduce_time = timer.means()

@@ -779,6 +779,21 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     return fns, hspec, tables, tables_full
 
 
+@jax.jit
+def param_global_norm(params) -> jax.Array:
+    """Global L2 norm over every param leaf (f32 accumulation).
+
+    The resilience divergence guard's cheap probe: a non-finite result means
+    some leaf went NaN/Inf even when the masked loss still reads finite.
+    Replicated inputs -> replicated scalar; one tiny fused reduction, run
+    host-side every `log_every` epochs only."""
+    leaves = [l for l in jax.tree.leaves(params) if hasattr(l, "dtype")]
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
 def init_training(cfg: Config, spec: ModelSpec, mesh: Mesh, seed: int = 0,
                   dtype=jnp.float32):
     """Replicated params / state / optimizer state (reference train.py:331-338).

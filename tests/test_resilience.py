@@ -1,0 +1,408 @@
+"""Resilience subsystem: checkpoint integrity chain, divergence rollback,
+deterministic fault injection, preemption, and the new CLI surface.
+
+Every recovery path the tentpole adds is proven here on the CPU mesh —
+in-process where the path is observable through run_training's API, and in
+tests/test_resilience_e2e.py via subprocess where the contract is an exit
+code. `--resilience off` bit-identity is pinned directly against the on-path.
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu import resilience
+from bnsgcn_tpu.config import Config, config_from_args, create_parser
+from bnsgcn_tpu.data.graph import sbm_graph
+from bnsgcn_tpu.models.gnn import ModelSpec, init_params
+from bnsgcn_tpu.trainer import make_tx, param_global_norm
+
+
+# ----------------------------------------------------------------------------
+# inject grammar
+# ----------------------------------------------------------------------------
+
+def test_inject_grammar_parses_full_matrix():
+    plan = resilience.FaultPlan.parse(
+        "nan@E12,sigterm@E20,hang@E8,ckpt-corrupt@E10")
+    assert plan.faults == {"nan": {12}, "sigterm": {20}, "hang": {8},
+                           "ckpt-corrupt": {10}}
+    # pop fires exactly once
+    assert plan.pop("nan", 12) and not plan.pop("nan", 12)
+    assert not plan.pop("sigterm", 19)
+    assert plan.pop("sigterm", 20)
+
+
+def test_inject_grammar_multiple_epochs_same_kind_and_empty():
+    plan = resilience.FaultPlan.parse("nan@E3,nan@E7")
+    assert plan.faults["nan"] == {3, 7}
+    assert resilience.FaultPlan.parse("").empty()
+    assert resilience.FaultPlan.parse("  ,  ").empty()
+
+
+@pytest.mark.parametrize("bad", ["nan@12", "nan", "oom@E3", "nan@Ex",
+                                 "nan@E-2", "sigkill@E1"])
+def test_inject_grammar_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        resilience.FaultPlan.parse(bad)
+
+
+# ----------------------------------------------------------------------------
+# checkpoint integrity chain
+# ----------------------------------------------------------------------------
+
+def _tiny_state(seed=0):
+    spec = ModelSpec("gcn", (4, 4, 2), norm="batch", dropout=0.1,
+                     train_size=10)
+    params, state = init_params(jax.random.key(seed), spec)
+    opt = make_tx(Config(lr=0.01)).init(params)
+    return params, state, opt
+
+
+def test_checksum_detects_flipped_byte(tmp_path):
+    params, state, opt = _tiny_state()
+    path = str(tmp_path / "a.ckpt")
+    ckpt.save_checkpoint(path, params=params, opt_state=opt, bn_state=state,
+                         epoch=3)
+    assert ckpt.load_checkpoint(path)["epoch"] == 3
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x01          # single bit flip mid-payload
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ckpt.CheckpointCorrupt, match="checksum"):
+        ckpt.load_checkpoint(path)
+
+
+def test_zero_byte_and_truncated_files_raise(tmp_path):
+    params, _, _ = _tiny_state()
+    path = str(tmp_path / "a.ckpt")
+    ckpt.save_checkpoint(path, params=params)
+    open(str(tmp_path / "zero.ckpt"), "wb").close()
+    with pytest.raises(ckpt.CheckpointCorrupt, match="zero-byte"):
+        ckpt.load_checkpoint(str(tmp_path / "zero.ckpt"))
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:20])    # torn inside the header
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.load_checkpoint(path)
+
+
+def test_legacy_checkpoint_without_magic_still_loads(tmp_path):
+    """Pre-checksum checkpoint dirs must keep resuming (no magic header)."""
+    from flax import serialization
+    path = str(tmp_path / "legacy.ckpt")
+    blob = serialization.msgpack_serialize(
+        {"params": {}, "opt_state": {}, "bn_state": {}, "epoch": 9,
+         "best_acc": 0.5, "seed": 1, "extra": {}})
+    open(path, "wb").write(blob)
+    payload = ckpt.load_checkpoint(path)
+    assert payload["epoch"] == 9
+
+
+def test_latest_valid_checkpoint_walks_past_corrupt_chain(tmp_path):
+    """The fallback chain: newest torn, next zero-byte, oldest good."""
+    cfg = Config(dataset="sbm", n_partitions=2, sampling_rate=0.5,
+                 ckpt_path=str(tmp_path), graph_name="g")
+    params, _, _ = _tiny_state()
+    for ep in (1, 3, 5):
+        ckpt.save_checkpoint(ckpt.periodic_path(cfg, ep), params=params,
+                             epoch=ep)
+    resilience.corrupt_file(ckpt.periodic_path(cfg, 5))
+    open(ckpt.periodic_path(cfg, 3), "wb").close()      # zero-byte
+    skipped = []
+    found = ckpt.latest_valid_checkpoint(cfg, log=skipped.append)
+    assert found is not None
+    path, payload = found
+    assert path.endswith("_1.ckpt") and payload["epoch"] == 1
+    assert len(skipped) == 2            # both bad files logged
+    # before_epoch guards rollback against "future" files of older runs
+    assert ckpt.latest_valid_checkpoint(cfg, before_epoch=1) is None
+    # all files bad -> None, not a crash
+    resilience.corrupt_file(ckpt.periodic_path(cfg, 1))
+    assert ckpt.latest_valid_checkpoint(cfg) is None
+
+
+# ----------------------------------------------------------------------------
+# divergence rollback
+# ----------------------------------------------------------------------------
+
+def _mgr(cfg, **kw):
+    return resilience.ResilienceManager(cfg, log=lambda *a, **k: None, **kw)
+
+
+def test_rollback_restores_bitwise_equal_to_checkpoint(tmp_path, monkeypatch):
+    """Post-rollback trees are bitwise-equal the checkpoint they restore."""
+    monkeypatch.setenv("BNSGCN_RETRY_BACKOFF_S", "0")
+    cfg = Config(dataset="sbm", n_partitions=2, sampling_rate=0.5,
+                 ckpt_path=str(tmp_path), graph_name="g", resil_retries=3)
+    params, state, opt = _tiny_state(seed=1)
+    ckpt.save_checkpoint(ckpt.periodic_path(cfg, 3), params=params,
+                         opt_state=opt, bn_state=state, epoch=3)
+    # templates are a DIFFERENT (poisoned-looking) state: restore must
+    # overwrite every leaf with the checkpoint bytes
+    p2, s2, o2 = _tiny_state(seed=9)
+    m = _mgr(cfg)
+    rp, ro, rs, restart, nonce = m.rollback(5, float("nan"), p2, o2, s2)
+    assert restart == 4 and nonce == 1
+    saved = ckpt.load_checkpoint(ckpt.periodic_path(cfg, 3))
+    expect, _, _ = ckpt.restore_into(saved, p2, o2, s2)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), rp, expect)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), rp, params)
+    assert m.rollbacks[0]["epoch"] == 5
+    assert m.rollbacks[0]["source"].endswith("_3.ckpt")
+
+
+def test_rollback_uses_initial_snapshot_before_any_checkpoint(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("BNSGCN_RETRY_BACKOFF_S", "0")
+    cfg = Config(dataset="sbm", n_partitions=2, sampling_rate=0.5,
+                 ckpt_path=str(tmp_path / "empty"), graph_name="g")
+    params, state, opt = _tiny_state(seed=2)
+    m = _mgr(cfg, start_epoch=0)
+    m.set_initial_snapshot(params, opt, state)
+    rp, ro, rs, restart, nonce = m.rollback(1, float("inf"), params, opt,
+                                            state)
+    assert restart == 0 and nonce == 1
+    assert m.rollbacks[0]["source"] == "<initial state>"
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), rp, params)
+
+
+def test_rollback_exhaustion_raises_diagnostic_report(tmp_path, monkeypatch):
+    monkeypatch.setenv("BNSGCN_RETRY_BACKOFF_S", "0")
+    cfg = Config(dataset="sbm", n_partitions=2, sampling_rate=0.5,
+                 ckpt_path=str(tmp_path), graph_name="g", resil_retries=1)
+    params, state, opt = _tiny_state()
+    m = _mgr(cfg)
+    m.set_initial_snapshot(params, opt, state)
+    m.rollback(4, float("nan"), params, opt, state)     # retry 1: allowed
+    with pytest.raises(resilience.DivergenceError, match="unrecovered"):
+        m.rollback(4, float("nan"), params, opt, state)
+    reports = [f for f in os.listdir(tmp_path) if f.startswith("divergence")]
+    assert reports, "diagnostic report file not written"
+
+
+def test_retry_budget_resets_after_healed_checkpoint(tmp_path, monkeypatch):
+    """N independent transients over a long run must each get the full
+    retry budget: a guard-verified checkpoint strictly past the last
+    rollback resets the counter (the key-fold nonce stays monotonic)."""
+    monkeypatch.setenv("BNSGCN_RETRY_BACKOFF_S", "0")
+    cfg = Config(dataset="sbm", n_partitions=2, sampling_rate=0.5,
+                 ckpt_path=str(tmp_path), graph_name="g", resil_retries=1)
+    params, state, opt = _tiny_state()
+    m = _mgr(cfg)
+    m.set_initial_snapshot(params, opt, state)
+    m.rollback(2, float("nan"), params, opt, state)
+    assert m.retries == 1
+    m.note_progress(2)              # not past the rollback epoch: no reset
+    assert m.retries == 1
+    m.note_progress(3)
+    assert m.retries == 0
+    # an independent later transient rolls back again instead of aborting
+    _, _, _, _, nonce = m.rollback(6, float("nan"), params, opt, state)
+    assert nonce == 2               # nonce never resets
+
+
+def test_two_distant_nan_transients_both_recover(tmp_path, small_graph,
+                                                 monkeypatch):
+    """e2e: with --resil-retries 1, two nan injections separated by healthy
+    checkpoints must BOTH recover (the budget reset in action)."""
+    monkeypatch.setenv("BNSGCN_RETRY_BACKOFF_S", "0")
+    from bnsgcn_tpu.run import run_training
+    res = run_training(
+        _base_cfg(tmp_path, inject="nan@E3,nan@E6", resil_retries=1),
+        g=small_graph, verbose=False)
+    assert [rb["epoch"] for rb in res.rollbacks] == [3, 6]
+    assert [rb["nonce"] for rb in res.rollbacks] == [1, 2]
+    assert len(res.losses) == 8 and np.all(np.isfinite(res.losses))
+
+
+def test_param_global_norm_flags_poisoned_params():
+    params, _, _ = _tiny_state()
+    assert np.isfinite(float(param_global_norm(params)))
+    poisoned = jax.tree.map(lambda x: x * np.nan, params)
+    assert not np.isfinite(float(param_global_norm(poisoned)))
+
+
+# ----------------------------------------------------------------------------
+# in-process fault-injection e2e through run_training
+# ----------------------------------------------------------------------------
+
+def _base_cfg(tmp_path, **kw):
+    d = dict(dataset="sbm", model="graphsage", n_partitions=2, n_layers=2,
+             n_hidden=8, sampling_rate=0.5, dropout=0.5, use_pp=True,
+             eval=False, n_epochs=8, log_every=2, seed=7, comm_trace=False,
+             part_path=str(tmp_path / "parts"),
+             ckpt_path=str(tmp_path / "ckpt"),
+             results_path=str(tmp_path / "res"))
+    d.update(kw)
+    return Config(**d)
+
+
+@pytest.fixture(scope="module")
+def small_graph():
+    return sbm_graph(n_nodes=240, n_class=3, n_feat=8, p_in=0.12, p_out=0.01,
+                     seed=3)
+
+
+def test_resilience_on_without_faults_bit_identical_to_off(tmp_path,
+                                                           small_graph):
+    """The default-on guard path must not perturb the training math: same
+    losses bitwise as --resilience off (the exact pre-resilience loop)."""
+    from bnsgcn_tpu.run import run_training
+    g = small_graph
+    r_off = run_training(
+        _base_cfg(tmp_path, resilience="off", ckpt_path=str(tmp_path / "c0")),
+        g=g, verbose=False)
+    r_on = run_training(
+        _base_cfg(tmp_path, resilience="on", ckpt_path=str(tmp_path / "c1")),
+        g=g, verbose=False)
+    np.testing.assert_array_equal(r_off.losses, r_on.losses)
+    assert r_on.rollbacks == []
+
+
+def test_nan_inject_rolls_back_and_recovers(tmp_path, small_graph,
+                                            monkeypatch):
+    """nan@E5: epoch 5 diverges, the guard rolls back to the epoch-3
+    periodic checkpoint and the run completes with finite losses under the
+    refolded sampling streams."""
+    monkeypatch.setenv("BNSGCN_RETRY_BACKOFF_S", "0")
+    from bnsgcn_tpu.run import run_training
+    res = run_training(_base_cfg(tmp_path, inject="nan@E5"), g=small_graph,
+                       verbose=False)
+    assert len(res.rollbacks) == 1
+    rb = res.rollbacks[0]
+    assert rb["epoch"] == 5 and rb["restart"] == 4 and rb["nonce"] == 1
+    assert rb["source"].endswith("_3.ckpt")
+    assert len(res.losses) == 8
+    assert np.all(np.isfinite(res.losses))
+
+
+def test_ckpt_corrupt_inject_falls_back_to_older_checkpoint(
+        tmp_path, small_graph, monkeypatch):
+    """ckpt-corrupt@E6 tears the newest (epoch-5) checkpoint; the nan@E6
+    rollback must walk past it to the epoch-3 file instead of crashing."""
+    monkeypatch.setenv("BNSGCN_RETRY_BACKOFF_S", "0")
+    from bnsgcn_tpu.run import run_training
+    res = run_training(
+        _base_cfg(tmp_path, inject="ckpt-corrupt@E6,nan@E6"),
+        g=small_graph, verbose=False)
+    assert len(res.rollbacks) == 1
+    assert res.rollbacks[0]["source"].endswith("_3.ckpt")
+    assert np.all(np.isfinite(res.losses))
+
+
+def test_sigterm_inject_preempts_then_resume_matches_uninterrupted(
+        tmp_path, small_graph):
+    """sigterm@E3: PreemptedError at the epoch-3 step boundary with a
+    resumable checkpoint; --resume continues and the remaining losses match
+    the uninterrupted run of the same seed (the e2e subprocess variant in
+    test_resilience_e2e.py additionally pins exit code 75)."""
+    from bnsgcn_tpu.run import run_training
+    g = small_graph
+    full = run_training(
+        _base_cfg(tmp_path, ckpt_path=str(tmp_path / "ck_full")),
+        g=g, verbose=False)
+    cfg_b = _base_cfg(tmp_path, ckpt_path=str(tmp_path / "ck_int"),
+                      inject="sigterm@E3")
+    with pytest.raises(resilience.PreemptedError) as ei:
+        run_training(cfg_b, g=g, verbose=False)
+    assert ei.value.epoch == 3
+    assert os.path.exists(ei.value.ckpt_path)
+    resumed = run_training(
+        cfg_b.replace(inject="", resume=True, seed=999), g=g, verbose=False)
+    np.testing.assert_allclose(resumed.losses, full.losses[4:], rtol=1e-6)
+
+
+def test_divergence_abort_after_retry_budget(tmp_path, small_graph,
+                                             monkeypatch):
+    """Injecting nan on every retry epoch exhausts --resil-retries and the
+    run aborts with the diagnostic DivergenceError instead of looping."""
+    monkeypatch.setenv("BNSGCN_RETRY_BACKOFF_S", "0")
+    from bnsgcn_tpu.run import run_training
+    # every epoch from 4 on is poisoned: rollback can never get past it
+    inj = ",".join(f"nan@E{e}" for e in range(4, 8))
+    with pytest.raises(resilience.DivergenceError):
+        run_training(_base_cfg(tmp_path, inject=inj, resil_retries=2),
+                     g=small_graph, verbose=False)
+
+
+# ----------------------------------------------------------------------------
+# diskcache stale-tmp sweep
+# ----------------------------------------------------------------------------
+
+def test_sweep_stale_tmp(tmp_path):
+    from bnsgcn_tpu.utils.diskcache import sweep_stale_tmp
+    d = str(tmp_path)
+    t_old = time.time() - 7200
+    # dead-PID tmp past the write grace: removed (crashed writer)
+    dead = os.path.join(d, "layouts_a.pkl.999999999.tmp")
+    open(dead, "wb").write(b"x")
+    os.utime(dead, (t_old, t_old))
+    # dead-LOOKING PID but freshly written: KEPT — on a shared volume this
+    # is another host's live writer mid-dump (its PID means nothing here)
+    peer = os.path.join(d, "layouts_p.pkl.999999998.tmp")
+    open(peer, "wb").write(b"x")
+    # live-PID fresh tmp: kept (a concurrent local writer mid-dump)
+    live = os.path.join(d, f"layouts_b.pkl.{os.getpid()}.tmp")
+    open(live, "wb").write(b"x")
+    # un-parsable tmp name, ancient mtime: removed by the age fallback
+    old = os.path.join(d, "noext.tmp")
+    open(old, "wb").write(b"x")
+    os.utime(old, (t_old, t_old))
+    # non-tmp files: untouched
+    keep = os.path.join(d, "layouts_c.pkl")
+    open(keep, "wb").write(b"x")
+    msgs = []
+    assert sweep_stale_tmp(d, log=msgs.append) == 2
+    assert os.path.exists(live) and os.path.exists(peer) and os.path.exists(keep)
+    assert not os.path.exists(dead) and not os.path.exists(old)
+    assert msgs and "2 stale" in msgs[0]
+    # second sweep: nothing left to remove, no log line
+    assert sweep_stale_tmp(d, log=msgs.append) == 0
+
+
+# ----------------------------------------------------------------------------
+# CLI arg-matrix: config.py drift guard for the new flags
+# (test_bench_preflight-style: every row must parse AND land in Config)
+# ----------------------------------------------------------------------------
+
+RESIL_ARG_MATRIX = [
+    ([], {"resilience": "on", "inject": "", "resil_retries": 3}),
+    (["--resilience", "off"], {"resilience": "off"}),
+    (["--resilience", "on"], {"resilience": "on"}),
+    (["--inject", "nan@E12,sigterm@E20,hang@E8,ckpt-corrupt@E10"],
+     {"inject": "nan@E12,sigterm@E20,hang@E8,ckpt-corrupt@E10"}),
+    (["--resil-retries", "7"], {"resil_retries": 7}),
+    (["--resil_retries", "7"], {"resil_retries": 7}),   # underscore alias
+    (["--resilience", "off", "--inject", "nan@E1", "--resil-retries", "0"],
+     {"resilience": "off", "inject": "nan@E1", "resil_retries": 0}),
+]
+
+
+@pytest.mark.quickgate
+@pytest.mark.parametrize("argv,expect", RESIL_ARG_MATRIX,
+                         ids=[" ".join(a) or "<defaults>"
+                              for a, _ in RESIL_ARG_MATRIX])
+def test_resilience_flags_reach_config(argv, expect):
+    cfg = config_from_args(create_parser().parse_args(argv))
+    for k, v in expect.items():
+        assert getattr(cfg, k) == v, (argv, k)
+    # every --inject value the matrix ships must parse under the grammar
+    resilience.FaultPlan.parse(cfg.inject)
+
+
+def test_resilience_flag_rejects_unknown_mode(capsys):
+    with pytest.raises(SystemExit):
+        create_parser().parse_args(["--resilience", "maybe"])
+    capsys.readouterr()
+
+
+def test_bad_inject_spec_fails_fast_at_manager_construction(tmp_path):
+    cfg = Config(inject="oom@E3", ckpt_path=str(tmp_path))
+    with pytest.raises(ValueError, match="unknown --inject fault"):
+        resilience.ResilienceManager(cfg, log=lambda *a: None)

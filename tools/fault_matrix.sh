@@ -1,0 +1,90 @@
+#!/bin/bash
+# Deterministic fault-injection matrix through the real CLI — the shell
+# twin of tests/test_resilience_e2e.py, runnable on any host (CPU mesh by
+# default) or on hardware before a long window: each of the four --inject
+# kinds must recover via its designed path / exit code, and a
+# sigterm-interrupted + resumed run must reach the uninterrupted run's
+# final loss.
+#
+#   JAX_PLATFORMS=cpu tools/fault_matrix.sh [workdir]
+#
+# Exit-code contract (bnsgcn_tpu/resilience.py, README "Fault tolerance"):
+#   75  preempted, resumable checkpoint written (relaunch with --resume)
+#   76  divergence unrecovered after --resil-retries rollbacks
+#   77  hung step: watchdog dumped stacks and killed the process
+set -u
+cd "$(dirname "$0")/.."
+WORK=${1:-$(mktemp -d /tmp/bnsgcn_faults.XXXXXX)}
+mkdir -p "$WORK"
+export PALLAS_AXON_POOL_IPS=""
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export BNSGCN_RETRY_BACKOFF_S=0
+# the 2-part mesh needs 2 devices; force a virtual CPU mesh unless the
+# caller already forces one (or runs on real hardware)
+if [ "$JAX_PLATFORMS" = cpu ] && \
+   ! printf '%s' "${XLA_FLAGS:-}" | grep -q host_platform_device_count; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=2"
+fi
+
+BASE="--dataset sbm --partition-method random --n-partitions 2 \
+  --model graphsage --n-layers 2 --n-hidden 8 --sampling-rate 0.5 --use-pp \
+  --n-epochs 8 --log-every 2 --no-eval --no-comm-trace --fix-seed --seed 11 \
+  --part-path $WORK/parts --results-path $WORK/res"
+
+FAIL=0
+check() {  # check <name> <want_rc> <got_rc>
+  if [ "$3" -eq "$2" ]; then
+    echo "PASS  $1 (exit $3)"
+  else
+    echo "FAIL  $1: want exit $2, got $3 (log: $WORK/$1.log)"
+    FAIL=1
+  fi
+}
+
+echo "== uninterrupted reference run =="
+python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_ref" \
+  > "$WORK/ref.log" 2>&1
+check ref 0 $?
+REF_LOSS=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/ref.log" | cut -d= -f2)
+
+echo "== nan@E5: divergence rollback, run completes =="
+python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_nan" \
+  --inject nan@E5 > "$WORK/nan.log" 2>&1
+check nan 0 $?
+grep -q 'rolled back to' "$WORK/nan.log" \
+  || { echo "FAIL  nan: no rollback line"; FAIL=1; }
+
+echo "== sigterm@E3: resumable exit 75, then --resume matches ref =="
+python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_sig" \
+  --inject sigterm@E3 > "$WORK/sigterm.log" 2>&1
+check sigterm 75 $?
+python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_sig" \
+  --resume --skip-partition --seed 999 > "$WORK/resume.log" 2>&1
+check resume 0 $?
+RES_LOSS=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/resume.log" | cut -d= -f2)
+if [ "$REF_LOSS" != "$RES_LOSS" ]; then
+  echo "FAIL  resume: final loss $RES_LOSS != uninterrupted $REF_LOSS"
+  FAIL=1
+else
+  echo "PASS  resume loss matches uninterrupted ($REF_LOSS)"
+fi
+
+echo "== ckpt-corrupt@E6 + nan@E6: fallback past the torn checkpoint =="
+python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_cor" \
+  --inject ckpt-corrupt@E6,nan@E6 > "$WORK/corrupt.log" 2>&1
+check ckpt-corrupt 0 $?
+grep -q 'skipping corrupt checkpoint' "$WORK/corrupt.log" \
+  || { echo "FAIL  ckpt-corrupt: chain walk not logged"; FAIL=1; }
+
+echo "== hang@E3: watchdog stack dump + exit 77 =="
+BNSGCN_WATCHDOG_MIN_S=1.5 BNSGCN_WATCHDOG_FACTOR=2 \
+  BNSGCN_WATCHDOG_GRACE_S=120 \
+  python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_hang" \
+  --inject hang@E3 > "$WORK/hang.log" 2>&1
+check hang 77 $?
+grep -q 'watchdog' "$WORK/hang.log" \
+  || { echo "FAIL  hang: no watchdog dump"; FAIL=1; }
+
+[ $FAIL -eq 0 ] && echo "fault matrix: ALL PASS ($WORK)" \
+  || echo "fault matrix: FAILURES (logs in $WORK)"
+exit $FAIL

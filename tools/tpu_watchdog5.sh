@@ -1,4 +1,10 @@
 #!/bin/bash
+# NOTE (resilience PR): hung-STEP detection now lives in-process
+# (bnsgcn_tpu/resilience.py — watchdog exits 77 with stack dumps; SIGTERM
+# preemption exits 75 resumable). A relaunch wrapper should requeue on exit
+# codes 75/77 rather than liveness-polling the python process; this script's
+# remaining job is bench-queue orchestration (cursor, requeue, best_known).
+#
 # Round-5 mid-session watchdog: the container restarted at ~07:05 UTC and
 # killed tpu_watchdog4 mid-queue (run[1] had just started; bench_cache was
 # wiped with the container). The tunnel is UP and the round-4 headline was
