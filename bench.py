@@ -325,6 +325,17 @@ def _supervise(args) -> int:
             # deterministic, relaunching would burn the whole TPU window
             log("  worker rejected its arguments (rc=2); not relaunching")
             return 2
+        if rc in (75, 76, 77, 78):
+            # resilience exit-code contract (README "Fault tolerance"):
+            # preempted / diverged / hung / coordinated-abort carry meaning
+            # the requeue wrapper (tools/tpu_watchdog5.sh handle_rc) acts
+            # on — propagate instead of blindly relaunching into a
+            # preempted chip or a deterministic divergence. The in-process
+            # watchdog os._exit(77)s a hung worker, so this is also the
+            # tunnel-outage path the deleted alive()-polling used to own.
+            log(f"  worker exited with resilience code rc={rc}; "
+                "propagating to the requeue wrapper")
+            return rc
         log(f"  worker exited rc={rc}; "
             f"{max(0, deadline - time.time()):.0f}s of budget left")
         # a worker that dies fast (before graph gen + compile could finish)

@@ -106,6 +106,22 @@ def load_checkpoint(path: str) -> dict[str, Any]:
             f"{path}: undecodable payload ({type(ex).__name__}: {ex})") from ex
 
 
+def load_or_error(path: str) -> tuple[Optional[dict], Optional[str]]:
+    """(payload, None) when `path` loads and verifies, else (None, reason)
+    — reason is one line (missing / torn / checksum-failed / undecodable).
+    The coordinated resume acks (run.py) send the reason through the
+    coordinator so a rank with a bad local copy fails loudly at the agreed
+    point instead of desyncing mid-epoch, and reuse the payload as the
+    restore source: one read + checksum per file, which matters at
+    papers100M checkpoint sizes."""
+    try:
+        return load_checkpoint(path), None
+    except CheckpointCorrupt as ex:
+        return None, str(ex)
+    except OSError as ex:
+        return None, f"{path}: unreadable ({type(ex).__name__}: {ex})"
+
+
 def restore_into(payload: dict, params_template, opt_template=None,
                  bn_template=None):
     """Restore arrays into the structure of freshly-initialized templates

@@ -140,6 +140,27 @@ class Config:
     resil_retries: int = 3              # divergence rollbacks (exponential
                                         # backoff) before aborting with a
                                         # diagnostic report
+    coord: str = "auto"                 # multi-host rank coordination channel
+                                        # (parallel/coord.py): 'auto' (tcp
+                                        # when >1 rank, else off) | 'tcp'
+                                        # (rank 0 serves --coord-port) |
+                                        # 'file' (shared --coord-dir) |
+                                        # 'off' (bit-identical PR-4 paths:
+                                        # no agreed verdicts, multi-host
+                                        # resilience downgraded)
+    coord_addr: str = ""                # coordinator host (default
+                                        # master_addr) for --coord tcp
+    coord_port: int = 18119             # rank 0's KV-server port (tcp)
+    coord_dir: str = ""                 # shared dir for --coord file
+                                        # (default {ckpt_path}/.coord)
+    coord_rank: int = -1                # this process's coordination rank;
+                                        # -1 = jax.process_index(). Explicit
+                                        # values enable the no-XLA-collective
+                                        # subprocess harness (each process a
+                                        # full single-host trainer, coupled
+                                        # only through the coordinator)
+    coord_world: int = 0                # total coordination ranks; 0 =
+                                        # jax.process_count()
     cache_dir: str = ""                 # persistent dir for SpMM layout pickles
                                         # (content-addressed by hybrid_layout_key);
                                         # default from $BNSGCN_CACHE_DIR — point it at
@@ -245,6 +266,19 @@ def create_parser() -> argparse.ArgumentParser:
                    help="deterministic fault injection, e.g. "
                         "'nan@E12,sigterm@E20,hang@E8,ckpt-corrupt@E10'")
     both("resil-retries", type=int, default=3)
+    p.add_argument("--coord", type=str, default="auto",
+                   choices=["auto", "tcp", "file", "off"],
+                   help="multi-host rank-coordination channel for agreed "
+                        "abort/rollback (off = the uncoordinated PR-4 "
+                        "behavior, bit-identical)")
+    both("coord-addr", type=str, default="")
+    both("coord-port", type=int, default=18119)
+    both("coord-dir", type=str, default="")
+    both("coord-rank", type=int, default=-1,
+         help="explicit coordination rank (with --coord-world: run the "
+              "coordinator without jax.distributed — the subprocess fault "
+              "harness)")
+    both("coord-world", type=int, default=0)
     both("cache-dir", type=str,
          default=os.environ.get("BNSGCN_CACHE_DIR", ""))
     both("edge-chunk", type=int, default=0)

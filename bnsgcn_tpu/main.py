@@ -19,6 +19,7 @@ import time
 
 from bnsgcn_tpu import resilience
 from bnsgcn_tpu.config import Config, parse_config
+from bnsgcn_tpu.parallel import coord
 from bnsgcn_tpu.run import prepare_partition, run_training
 
 
@@ -43,7 +44,20 @@ def main(argv=None):
         seed = multihost_utils.broadcast_one_to_all(np.int64(cfg.seed))
         cfg = cfg.replace(seed=int(seed))
 
-    if not cfg.skip_partition and cfg.node_rank == 0:
+    # coordination rank 0 only (cfg.coord_rank > 0 is a harness-mode peer
+    # process sharing the partition dir — two builders would race); real
+    # multi-host keeps the node_rank gate + barrier below. The peer-skip
+    # is only safe because run_training's coordinator barrier exists — with
+    # coordination disabled there is NO cross-process sync at all, so that
+    # combination must be a named config error, not a silent race.
+    if (cfg.coord_world and cfg.coord_world > 1 and not cfg.skip_partition
+            and (cfg.resilience != "on" or cfg.coord == "off")):
+        print("--coord-world > 1 with coordination disabled (--coord off / "
+              "--resilience off) has no cross-process partition barrier: "
+              "pre-partition with partition_cli and pass --skip-partition",
+              file=sys.stderr)
+        sys.exit(2)
+    if not cfg.skip_partition and cfg.node_rank == 0 and cfg.coord_rank <= 0:
         t0 = time.time()
         prepare_partition(cfg, load=False)
         print(f"partition ready in {time.time() - t0:.1f}s -> {cfg.part_path}")
@@ -75,6 +89,22 @@ def main(argv=None):
     except resilience.DivergenceError as ex:
         print(f"[resilience] {ex}", file=sys.stderr)
         sys.exit(resilience.EXIT_DIVERGED)
+    except coord.CoordTimeout as ex:
+        # a peer (or the rank-0 server) stopped answering: the coordinator
+        # already printed the peer-liveness table naming the stalled rank.
+        # Same exit code as the hung-step watchdog — to a requeue wrapper
+        # both mean "the job hung; stderr says where".
+        print(f"[coord] {ex}", file=sys.stderr)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(resilience.EXIT_WATCHDOG)
+    except coord.CoordAbort as ex:
+        # the ranks AGREED to abort (e.g. a peer cannot load the chosen
+        # checkpoint): distinct code — triage, not a blind requeue
+        print(f"[coord] {ex}", file=sys.stderr)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(resilience.EXIT_COORD_ABORT)
     # machine-parseable summary for harnesses (fault-matrix e2e compares a
     # resumed run's final loss against an uninterrupted one through this)
     print("RESULT final_loss=%.9e best_val=%.6f test=%.6f"

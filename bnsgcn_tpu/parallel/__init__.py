@@ -1,3 +1,6 @@
+from bnsgcn_tpu.parallel.coord import (Coordinator, CoordAbort, CoordError,
+                                       CoordTimeout, FileTransport,
+                                       TcpTransport, make_coordinator)
 from bnsgcn_tpu.parallel.sampling import pair_key, pair_sample
 from bnsgcn_tpu.parallel.halo import HaloSpec, make_halo_plan, halo_apply, sampled_presence
 from bnsgcn_tpu.parallel.mesh import make_parts_mesh

@@ -32,9 +32,19 @@ from OUTSIDE the process. This module brings all four recoveries in-process:
 
 `--resilience off` constructs none of this: the loop is bit-identical to the
 pre-resilience code path (no extra device ops, no threads, no handlers).
-Multi-host runs also disable the manager for now — a coordinated abort across
-ranks is an open follow-up (ROADMAP) — but the checkpoint integrity chain
-(checkpoint.latest_valid_checkpoint) still protects rank 0's resume.
+
+**Multi-host** (this PR): with a rank coordinator (`parallel/coord.py`,
+`--coord`) the manager runs on EVERY rank and the verdicts travel out-of-
+band from the XLA collectives. At each step boundary `agree_step` contributes
+the rank's local {ok, diverged, preempted} state; rank 0 reduces worst-wins
+and all ranks act on the one agreed decision — a SIGTERM on a single rank
+becomes a clean all-rank resumable exit 75, a NaN on any rank becomes a
+coordinated rollback where rank 0 selects the checkpoint and broadcasts the
+(restart epoch, retry nonce) every rank restores with, and a rank that
+cannot restore fails the post-restore ack so everyone aborts loudly instead
+of desyncing. The watchdog additionally dumps per-rank heartbeat liveness
+before exit 77, naming the rank that stalled a hung collective. Multi-host
+with `--coord off` keeps the PR-4 downgrade (rank-0 integrity chain only).
 
 Timing knobs are env vars, not flags, so CI can shrink them without widening
 the CLI surface:
@@ -42,6 +52,7 @@ the CLI surface:
   BNSGCN_WATCHDOG_FACTOR    deadline = max(MIN, FACTOR * rolling mean) (20)
   BNSGCN_WATCHDOG_MIN_S     deadline floor after the first step (300)
   BNSGCN_RETRY_BACKOFF_S    rollback backoff base, doubled per retry (1.0)
+  BNSGCN_COORD_TIMEOUT_S    per-exchange coordinator deadline (120)
 """
 
 from __future__ import annotations
@@ -56,13 +67,19 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu.parallel.coord import CoordAbort
 
-# Distinct exit codes so a requeue wrapper (the tools/tpu_watchdog*.sh role,
+# Distinct exit codes so a requeue wrapper (the tools/tpu_watchdog5.sh role,
 # now consolidated in-process) can tell retryable states apart:
 EXIT_PREEMPTED = 75   # EX_TEMPFAIL: resumable checkpoint written; relaunch
                       # with --resume continues bit-for-bit
 EXIT_DIVERGED = 76    # rollback retries exhausted; diagnostic report printed
 EXIT_WATCHDOG = 77    # hung step: stacks + live arrays dumped to stderr
+                      # (multi-host: also a coordinator exchange timeout,
+                      # after the peer-liveness dump named the stalled rank)
+EXIT_COORD_ABORT = 78  # ranks agreed to abort: a peer cannot restore the
+                       # chosen checkpoint (rollback or resume ack) — needs
+                       # triage, not a blind requeue
 
 FAULT_KINDS = ("nan", "sigterm", "hang", "ckpt-corrupt")
 
@@ -84,6 +101,12 @@ class DivergenceError(Exception):
     the full diagnostic report (also written next to the checkpoints)."""
 
 
+class CheckpointUnavailable(Exception):
+    """A rank could not obtain the agreed restore source (no usable file,
+    no snapshot). Internal to coord_restore: it is reported through the
+    coordinator ack so all ranks abort together, never raised past it."""
+
+
 # ----------------------------------------------------------------------------
 # fault-injection plan
 # ----------------------------------------------------------------------------
@@ -95,23 +118,32 @@ class FaultPlan:
     faults: dict = field(default_factory=dict)   # kind -> set of epochs
 
     @staticmethod
-    def parse(spec: str) -> "FaultPlan":
-        """Grammar: comma-separated `kind@E<epoch>` terms, e.g.
-        `nan@E12,sigterm@E20,hang@E8,ckpt-corrupt@E10`. Unknown kinds or
-        malformed terms raise — a typo'd injection silently not firing would
-        make a CI fault run vacuously green."""
+    def parse(spec: str, rank: int = 0) -> "FaultPlan":
+        """Grammar: comma-separated `kind@E<epoch>[:r<rank>]` terms, e.g.
+        `nan@E12,sigterm@E20:r1,hang@E8,ckpt-corrupt@E10`. The rank suffix
+        targets one rank of a multi-host run (partial faults — the whole
+        point of the coordinated-abort tests); the rank-less form keeps its
+        historical meaning, "fire on all ranks". Every term is validated
+        even when targeted elsewhere — a typo'd injection silently not
+        firing would make a CI fault run vacuously green."""
         plan = FaultPlan()
         for term in filter(None, (t.strip() for t in spec.split(","))):
-            kind, sep, ep = term.partition("@")
+            kind, sep, rest = term.partition("@")
+            ep, rsep, rk = rest.partition(":")
             if (not sep or not ep.startswith("E")
-                    or not ep[1:].isdigit()):
+                    or not ep[1:].isdigit()
+                    or (rsep and not (rk.startswith("r")
+                                      and rk[1:].isdigit()))):
                 raise ValueError(
-                    f"bad --inject term {term!r}: expected kind@E<epoch> "
+                    f"bad --inject term {term!r}: expected "
+                    f"kind@E<epoch>[:r<rank>] "
                     f"(kinds: {', '.join(FAULT_KINDS)})")
             if kind not in FAULT_KINDS:
                 raise ValueError(
                     f"unknown --inject fault {kind!r} "
                     f"(kinds: {', '.join(FAULT_KINDS)})")
+            if rsep and int(rk[1:]) != rank:
+                continue                # valid term, targets another rank
             plan.faults.setdefault(kind, set()).add(int(ep[1:]))
         return plan
 
@@ -139,10 +171,13 @@ class _Watchdog(threading.Thread):
 
     POLL_S = 0.25
     ROLLING = 20
+    ALIVE_BEAT_S = 2.0      # coord: watchdog-thread heartbeat period, so
+                            # peers can tell "process dead" from "step slow"
 
-    def __init__(self, log=print):
+    def __init__(self, log=print, coord=None):
         super().__init__(name="bnsgcn-watchdog", daemon=True)
         self.log = log
+        self.coord = coord
         self.grace_s = float(os.environ.get("BNSGCN_WATCHDOG_GRACE_S", 600))
         self.factor = float(os.environ.get("BNSGCN_WATCHDOG_FACTOR", 20))
         # floor of 300 s: epoch-boundary work that is slow-but-legit (a
@@ -185,7 +220,21 @@ class _Watchdog(threading.Thread):
         self._halt.set()
 
     def run(self):
+        last_alive = 0.0
         while not self._halt.wait(self.POLL_S):
+            if self.coord is not None:
+                # alive-beat from THIS thread: proves the process is up even
+                # while the main thread is stuck inside a collective —
+                # exactly what the peers' liveness dump needs to separate
+                # "rank died" from "rank hung"
+                now = time.monotonic()
+                if now - last_alive >= self.ALIVE_BEAT_S:
+                    last_alive = now
+                    try:
+                        self.coord.heartbeat(self._epoch,
+                                             self.coord.ALIVE_KEY)
+                    except Exception:
+                        pass        # best-effort; never kills the watchdog
             idle = time.monotonic() - self._last_beat
             deadline = self.deadline_s()
             if idle <= deadline:
@@ -213,6 +262,15 @@ class _Watchdog(threading.Thread):
                         f"[watchdog]   {a.dtype} {tuple(a.shape)}\n")
             except Exception:
                 pass
+            if self.coord is not None:
+                # a hung collective should name the rank that stalled it:
+                # dump every peer's last step-boundary heartbeat (epoch +
+                # age) before dying
+                try:
+                    self.coord.log_liveness(
+                        write=lambda s: sys.stderr.write(s + "\n"))
+                except Exception:
+                    pass
             sys.stderr.flush()
         except Exception:
             pass    # dumping must never mask the exit itself
@@ -223,20 +281,26 @@ class _Watchdog(threading.Thread):
 # ----------------------------------------------------------------------------
 
 class ResilienceManager:
-    """One per run_training call (single-host, `--resilience on`). Owns the
-    signal handlers, the watchdog, the fault plan, and the rollback state;
+    """One per run_training call (`--resilience on`). Owns the signal
+    handlers, the watchdog, the fault plan, and the rollback state;
     `close()` restores the process to its pre-run state so sequential
-    run_training calls (tests, bench sweeps) never leak handlers/threads."""
+    run_training calls (tests, bench sweeps) never leak handlers/threads.
+
+    Single-host: `coord` is None and the manager behaves exactly as in
+    PR 4. Multi-host (`--coord`): one manager per rank, every local verdict
+    routed through `agree_step` so all ranks act together."""
 
     def __init__(self, cfg, log=print, start_epoch: int = 0,
-                 retry_nonce: int = 0):
+                 retry_nonce: int = 0, coord=None):
         self.cfg = cfg
         self.log = log
         self.start_epoch = start_epoch
+        self.coord = coord
+        self.rank = coord.rank if coord is not None else 0
         self.plan = FaultPlan.parse(
-            cfg.inject or os.environ.get("BNSGCN_FAULT", ""))
+            cfg.inject or os.environ.get("BNSGCN_FAULT", ""), rank=self.rank)
         if not self.plan.empty():
-            log(f"[resilience] fault plan armed: "
+            log(f"[resilience] fault plan armed (rank {self.rank}): "
                 + ",".join(f"{k}@E{e}" for k, eps in
                            sorted(self.plan.faults.items())
                            for e in sorted(eps)))
@@ -250,7 +314,10 @@ class ResilienceManager:
         self._preempt: Optional[str] = None
         self._old_handlers: dict = {}
         self._snapshot = None
-        self.watchdog = _Watchdog(log)
+        self._pending_payload = None    # rank 0: the checkpoint payload
+                                        # plan_rollback just validated, so
+                                        # coord_restore never re-reads it
+        self.watchdog = _Watchdog(log, coord=coord)
 
     # -- lifecycle --
 
@@ -318,6 +385,13 @@ class ResilienceManager:
     def rollback(self, epoch: int, loss_f: float, params_t, opt_t, state_t):
         """Restore the last good state after a non-finite loss/param probe.
 
+        TWIN of plan_rollback/coord_restore (the coordinated split of the
+        same policy): retry budget, checkpoint selection, nonce and backoff
+        MUST stay in lockstep — change one, change both. Kept separate
+        because this single-host path is behavior-pinned bitwise by the
+        PR-4 tests (sleep-before-restore ordering, log wording) and the
+        coordinated path must publish its decision BEFORE sleeping.
+
         Returns (params_host, opt_host, state_host, restart_epoch, nonce):
         host trees bitwise-equal the checkpoint they restore (pinned by
         tests/test_resilience.py), the epoch to resume the loop at, and the
@@ -381,6 +455,147 @@ class ResilienceManager:
         except OSError:
             pass
         return report
+
+    # -- multi-host agreed verdicts (coord != None) --
+
+    def agree_step(self, epoch: int, state: str, loss_f: float = 0.0) -> dict:
+        """One step-boundary verdict exchange: contribute this rank's local
+        state ('ok' | 'diverged' | 'preempted'), return the agreed decision
+        every rank acts on. Rank 0 owns the reduce and — for 'rollback' —
+        the checkpoint selection, restart epoch, retry nonce and backoff;
+        non-0 ranks record the rollback from the decision so their
+        RunResult.rollbacks and nonce stay rank-consistent."""
+        decide = None
+        if self.coord.rank == 0:
+            def decide(name, states):
+                if name == "rollback":
+                    return self.plan_rollback(epoch, loss_f, states)
+                if name == "preempt":
+                    who = [r for r, s in states.items() if s == "preempted"]
+                    return {"decision": "preempt", "ranks": who}
+                if name == "abort":
+                    return {"decision": "abort", "why": "peer",
+                            "report": f"a rank reported abort: {states}"}
+                return {"decision": "ok"}
+        decision = self.coord.agree(epoch, state, decide)
+        if decision["decision"] == "rollback" and self.coord.rank != 0:
+            self.nonce = int(decision["nonce"])
+            self.rollbacks.append({
+                "epoch": int(decision["epoch"]),
+                "restart": int(decision["restart"]),
+                "source": decision["source"], "nonce": self.nonce})
+            self.log(
+                f"[resilience] agreed rollback (decided by rank 0): epoch "
+                f"{decision['epoch']} -> restart {decision['restart']} from "
+                f"{decision['source']}, retry-nonce {self.nonce}")
+        return decision
+
+    def plan_rollback(self, epoch: int, loss_f: float,
+                      states: Optional[dict] = None) -> dict:
+        """Rank 0's half of a coordinated rollback: pick the newest valid
+        checkpoint (or the initial snapshot), advance the retry/nonce
+        accounting, and return the decision payload every rank restores
+        with. Retry exhaustion returns an 'abort' decision carrying the
+        diagnostic report instead — all ranks then raise DivergenceError,
+        so the whole job exits 76 consistently. The backoff is NOT slept
+        here (the decision must publish before peers' exchange deadline);
+        each rank sleeps `backoff_s` locally before restoring.
+
+        TWIN of the single-host rollback() — same retry/selection/nonce/
+        backoff policy, split at the publish point; keep them in lockstep
+        (see rollback's docstring for why they are not one function)."""
+        self.retries += 1
+        limit = max(int(self.cfg.resil_retries), 0)
+        found = ckpt.latest_valid_checkpoint(self.cfg, log=self.log,
+                                             before_epoch=epoch)
+        if self.retries > limit:
+            return {"decision": "abort", "why": "divergence",
+                    "report": self._report(epoch, loss_f, found)}
+        if found is not None:
+            path, self._pending_payload = found
+            restart = int(self._pending_payload["epoch"]) + 1
+            src = os.path.basename(path)
+        else:
+            if self._snapshot is None:
+                return {"decision": "abort", "why": "divergence",
+                        "report": self._report(epoch, loss_f, None)}
+            self._pending_payload = None
+            restart = self.start_epoch
+            src = "<initial state>"
+        self.nonce += 1
+        self.rollbacks.append({"epoch": epoch, "restart": restart,
+                               "source": src, "nonce": self.nonce})
+        diverged = sorted(r for r, s in (states or {}).items()
+                          if s == "diverged")
+        self.log(
+            f"[resilience] non-finite training state at epoch {epoch} on "
+            f"rank(s) {diverged or [self.rank]} (loss={loss_f}): agreed "
+            f"rollback to {src}, restarting all ranks at epoch {restart} "
+            f"with retry-nonce {self.nonce} (retry {self.retries}/{limit})")
+        return {"decision": "rollback", "epoch": int(epoch),
+                "restart": int(restart), "nonce": int(self.nonce),
+                "source": src, "retry": self.retries, "limit": limit,
+                "backoff_s": min(self.backoff_cap,
+                                 self.backoff_base * (2 ** (self.retries - 1)))}
+
+    def coord_restore(self, decision: dict, params_t, opt_t, state_t,
+                      restore_local: bool = True):
+        """Every rank's half of a coordinated rollback: sleep the agreed
+        backoff, restore the decision's source from the local checkpoint
+        dir (rank 0 reuses the payload plan_rollback already validated; the
+        initial-snapshot source restores each rank's own host snapshot —
+        replicated params, so identical), then ack. A rank whose restore
+        fails fails the ack and EVERY rank raises CoordAbort: a loud agreed
+        abort, never a silent epoch desync. `restore_local=False` (the
+        real-multi-host peers, whose state arrives via the rank-0 XLA
+        broadcast) skips the local load but STILL joins the ack — a rank-0
+        restore failure must surface as the agreed exit 78 on all ranks
+        BEFORE anyone blocks inside the XLA collective, not as rank 0
+        aborting alone while its peers hang to the watchdog (77)."""
+        backoff = float(decision.get("backoff_s", 0.0))
+        if backoff > 0:
+            self.log(f"[resilience] backing off {backoff:.1f}s before "
+                     f"agreed retry {decision.get('retry')}"
+                     f"/{decision.get('limit')}")
+            time.sleep(backoff)
+        src = decision["source"]
+        ok, err, out = True, "", (params_t, opt_t, state_t)
+        if restore_local:
+            try:
+                if src == "<initial state>":
+                    if self._snapshot is None:
+                        raise CheckpointUnavailable("no initial snapshot")
+                    out = self._snapshot
+                else:
+                    payload = self._pending_payload
+                    if payload is None:
+                        payload = ckpt.load_checkpoint(
+                            os.path.join(self.cfg.ckpt_path, src))
+                    out = ckpt.restore_into(payload, params_t, opt_t, state_t)
+            except (ckpt.CheckpointCorrupt, CheckpointUnavailable,
+                    OSError) as ex:
+                ok, err = False, f"{type(ex).__name__}: {ex}"
+                self.log(f"[resilience] rank {self.rank} cannot restore "
+                         f"{src}: {err}")
+            finally:
+                self._pending_payload = None
+        all_ok, fails = self.coord.gather_ok("rollback", ok, err)
+        if not all_ok:
+            raise CoordAbort(
+                "coordinated rollback failed — rank(s) could not restore "
+                f"{src!r}: "
+                + "; ".join(f"rank {r}: {d}" for r, d in sorted(fails.items())))
+        return out
+
+    @staticmethod
+    def raise_abort(decision: dict):
+        """Map an agreed 'abort' decision to the exception (and thus exit
+        code) it belongs to, identically on every rank."""
+        if decision.get("why") == "divergence":
+            raise DivergenceError(decision.get("report",
+                                               "divergence abort (agreed)"))
+        raise CoordAbort(decision.get("report",
+                                      "coordinated abort (agreed)"))
 
     # -- fault injection --
 

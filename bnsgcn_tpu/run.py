@@ -40,6 +40,7 @@ from bnsgcn_tpu.data.graph import Graph
 from bnsgcn_tpu.data.partitioner import partition_graph
 from bnsgcn_tpu.evaluate import evaluate_induc, evaluate_mesh, evaluate_trans
 from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
+from bnsgcn_tpu.parallel import coord as coord_mod
 from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc
 from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns, init_training,
                                 local_part_ids, param_global_norm, place_blocks,
@@ -85,6 +86,25 @@ def prepare_partition(cfg: Config, g: Optional[Graph] = None,
     return art
 
 
+def _final_best_payload(cfg: Config, best_acc: float, log):
+    """The best-params recovery contract, shared by every resume path
+    (single-host, uncoordinated multi-host, coordinated): the final
+    checkpoint must load AND carry the resumed best_acc (within 1e-9) or
+    it belongs to another run — the caller then restarts best tracking
+    instead of adopting foreign params. Returns the validated payload
+    (reused for restore_into — one read+checksum total) or None."""
+    fpath = ckpt.final_path(cfg)
+    payload, err = ckpt.load_or_error(fpath)
+    if payload is None:
+        if err and os.path.exists(fpath):
+            log(f"[resilience] final checkpoint unusable ({err}); "
+                f"restarting best tracking")
+        return None
+    if abs(float(payload.get("best_acc", -1.0)) - best_acc) >= 1e-9:
+        return None
+    return payload
+
+
 @dataclass
 class RunResult:
     best_val_acc: float = 0.0
@@ -110,6 +130,23 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
     multi_host = jax.process_count() > 1
     is_rank0 = jax.process_index() == 0
+
+    # ---- out-of-band rank coordination (multi-host resilience) ----
+    # parallel/coord.py: failure verdicts travel OUTSIDE the XLA collectives
+    # so a faulting rank can tell its peers instead of hanging them. None
+    # under --coord off / single-rank runs — those paths are bit-identical
+    # to the uncoordinated loop. --coord-rank/--coord-world run the same
+    # layer without jax.distributed (each process a full single-host
+    # trainer, coupled only through the coordinator): the subprocess fault
+    # harness the CPU container can actually execute.
+    coordinator, coord_rank = None, jax.process_index()
+    if cfg.resilience == "on" and cfg.coord != "off":
+        coordinator, coord_rank, _ = coord_mod.make_coordinator(cfg, log)
+        if coordinator is not None and not multi_host:
+            # external-rank harness mode: coordination rank 0 owns the
+            # checkpoint dir (and host eval), exactly like jax rank 0 does
+            # in a real multi-host run
+            is_rank0 = coord_rank == 0
 
     # ---- data + eval graphs (train.py:313-319) ----
     # multi-host: only rank 0 ever needs the full undistributed graph (host
@@ -161,6 +198,17 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             art = load_artifacts(artifacts_dir(cfg), parts=mine)
         elif cfg.skip_partition:
             art = load_artifacts(artifacts_dir(cfg))
+        elif coordinator is not None:
+            # harness mode without --skip-partition: only rank 0 builds;
+            # peers wait at a coordinator barrier, then load the finished
+            # artifacts — two concurrent builders would tear the shared dir
+            # (real multi-host has main.py's XLA barrier for this)
+            if coord_rank == 0:
+                art = prepare_partition(cfg, train_g)
+                coordinator.broadcast("parts-ready", {"ok": 1})
+            else:
+                coordinator.broadcast("parts-ready")
+                art = prepare_partition(cfg, train_g)
         else:
             art = prepare_partition(cfg, train_g)
     cfg = cfg.replace(n_feat=art.n_feat, n_class=art.n_class, n_train=art.n_train)
@@ -332,6 +380,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
     # ---- model / optimizer init, optionally resumed ----
     seed = cfg.seed
+    if coordinator is not None and not multi_host:
+        # harness-mode analogue of main.py's XLA seed broadcast: every rank
+        # must adopt rank 0's (possibly randomized) seed or the shared-PRNG
+        # sampling/dropout/init streams desync across ranks
+        seed = int(coordinator.broadcast(
+            "seed", {"seed": seed} if coord_rank == 0 else None)["seed"])
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     params, state, opt_state = init_training(cfg, spec, mesh, seed=seed, dtype=dtype)
     start_epoch, best_acc, best_params = 0, 0.0, None
@@ -339,7 +393,109 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                         # sampling/dropout key streams (resilience.py) and
                         # round-trips through checkpoint extra so a resumed
                         # run continues the post-rollback streams bit-for-bit
-    if cfg.resume and multi_host:
+    if cfg.resume and coordinator is not None:
+        # ---- rank-consistent recovery: rank 0 WALKS the chain, everyone
+        # else loads exactly rank 0's choice. Two ranks walking
+        # independently can pick DIFFERENT files (one rank's newest local
+        # copy torn, the other's fine) and silently desync the epoch
+        # schedule; and the uncoordinated multi-host path broadcast rank
+        # 0's epoch without ever checking the peers could load it. Every
+        # rank acks loadability through the coordinator BEFORE any state is
+        # adopted — a torn local file aborts the resume loudly on ALL
+        # ranks (exit 78), not mid-epoch. ----
+        choice = None
+        if coord_rank == 0:
+            found = ckpt.latest_valid_checkpoint(cfg, log=log)
+            if found:
+                path0, payload0 = found
+                choice = {"have": 1, "file": os.path.basename(path0),
+                          "epoch": int(payload0["epoch"]) + 1,
+                          "seed": int(payload0.get("seed", seed)),
+                          "nonce": int((payload0.get("extra") or {})
+                                       .get("retry_nonce", 0)),
+                          "best_acc": float(payload0["best_acc"])}
+            else:
+                choice = {"have": 0}
+        choice = coordinator.broadcast("resume-choice", choice)
+        if choice["have"]:
+            cpath = os.path.join(cfg.ckpt_path, choice["file"])
+            # one load per rank, reused for the restore below: rank 0's walk
+            # above already read + checksummed its payload (multi-GB at
+            # papers100M scale — never read the same file twice); each peer
+            # loads its local copy once, and the load IS the ack
+            payload1, err = (payload0, None) if coord_rank == 0 else (None, None)
+            if coord_rank != 0:
+                payload1, err = ckpt.load_or_error(cpath)
+                if err is not None and multi_host and not os.path.exists(cpath):
+                    # no local copy at all: fine on a real pod — the state
+                    # arrives via the rank-0 XLA broadcast below. A PRESENT
+                    # but torn copy is never fine: this rank's disk lies.
+                    err = None
+            all_ok, fails = coordinator.gather_ok("resume", err is None,
+                                                  err or "")
+            if not all_ok:
+                raise coord_mod.CoordAbort(
+                    "resume aborted by agreement: rank(s) cannot load the "
+                    f"chosen checkpoint {choice['file']!r}: "
+                    + "; ".join(f"rank {r}: {d}"
+                                for r, d in sorted(fails.items())))
+            seed = int(choice["seed"])
+            retry_nonce = int(choice["nonce"])
+            start_epoch = int(choice["epoch"])
+            best_acc = float(choice["best_acc"])
+            if multi_host:
+                # state still travels the proven XLA broadcast: rank 0
+                # restores its validated payload, peers receive the trees
+                from jax.experimental import multihost_utils
+                host = (ckpt.restore_into(payload1, jax.device_get(params),
+                                          jax.device_get(opt_state),
+                                          jax.device_get(state))
+                        if is_rank0 else
+                        (jax.device_get(params), jax.device_get(opt_state),
+                         jax.device_get(state)))
+                host = multihost_utils.broadcast_one_to_all(host)
+            else:
+                host = ckpt.restore_into(payload1, jax.device_get(params),
+                                         jax.device_get(opt_state),
+                                         jax.device_get(state))
+            params = place_replicated(host[0], mesh)
+            opt_state = place_replicated(host[1], mesh)
+            state = place_replicated(host[2], mesh)
+            log(f"Resumed (agreed via coordinator) from {choice['file']} at "
+                f"epoch {start_epoch}")
+            if best_acc > 0:
+                # best-params recovery, same contract as the uncoordinated
+                # paths: the final ckpt must carry the matching best_acc or
+                # best tracking restarts. One load per participating rank
+                # (multi-host: rank 0 only — peers receive the XLA
+                # broadcast; harness mode: every rank restores its local
+                # copy), reused for both the best_acc probe and the
+                # restore. The ranks AGREE before adopting anything, so a
+                # torn/stale local copy on one harness rank degrades best
+                # tracking on ALL ranks instead of crashing that rank or
+                # desyncing the final eval.
+                payf = (_final_best_payload(cfg, best_acc, log)
+                        if coord_rank == 0 or not multi_host else None)
+                if multi_host:
+                    rec = coordinator.broadcast(
+                        "resume-best",
+                        {"recovered": int(payf is not None)}
+                        if coord_rank == 0 else None)
+                    recovered = bool(rec["recovered"])
+                else:
+                    recovered, _ = coordinator.gather_ok(
+                        "resume-best", payf is not None)
+                if recovered and multi_host:
+                    from jax.experimental import multihost_utils
+                    bp = (ckpt.restore_into(payf, jax.device_get(params))[0]
+                          if is_rank0 else jax.device_get(params))
+                    best_params = multihost_utils.broadcast_one_to_all(bp)
+                elif recovered:
+                    best_params = ckpt.restore_into(
+                        payf, jax.device_get(params))[0]
+                else:
+                    best_acc = 0.0
+    elif cfg.resume and multi_host:
         # rank 0 reads (and integrity-validates) the checkpoint; everything
         # restored must be broadcast so all processes drive the SPMD loop
         # over the same epoch range
@@ -379,21 +535,10 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # recover best params (rank 0 reads the matching final ckpt, all
             # ranks receive them — the final mesh test eval is a collective);
             # no match -> restart best tracking, same as single-host
-            recovered = np.int64(0)
-            fp = None
-            if is_rank0 and best_acc > 0:
-                fpath = ckpt.final_path(cfg)
-                if os.path.exists(fpath):
-                    try:
-                        fp = ckpt.load_checkpoint(fpath)
-                    except ckpt.CheckpointCorrupt as ex:
-                        log(f"[resilience] final checkpoint unusable ({ex}); "
-                            f"restarting best tracking")
-                        fp = None
-                    if fp is not None and abs(
-                            float(fp.get("best_acc", -1.0)) - best_acc) < 1e-9:
-                        recovered = np.int64(1)
-            recovered = int(multihost_utils.broadcast_one_to_all(recovered))
+            fp = (_final_best_payload(cfg, best_acc, log)
+                  if is_rank0 and best_acc > 0 else None)
+            recovered = int(multihost_utils.broadcast_one_to_all(
+                np.int64(fp is not None)))
             if best_acc > 0 and recovered:
                 bp = (ckpt.restore_into(fp, jax.device_get(params))[0]
                       if is_rank0 else jax.device_get(params))
@@ -424,22 +569,13 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                               .get("retry_nonce", 0))
             log(f"Resumed from {latest} at epoch {start_epoch}")
             # recover the best-so-far params (final ckpt) so a resumed run that
-            # never beats the old best still saves/evaluates a best model; the
-            # final ckpt must carry the same best_acc or it belongs to another
-            # run — then restart best tracking instead of adopting foreign params
-            fpath = ckpt.final_path(cfg)
-            recovered = False
-            if best_acc > 0 and os.path.exists(fpath):
-                try:
-                    fp = ckpt.load_checkpoint(fpath)
-                except ckpt.CheckpointCorrupt as ex:
-                    log(f"[resilience] final checkpoint unusable ({ex}); "
-                        f"restarting best tracking")
-                    fp = {}
-                if abs(float(fp.get("best_acc", -1.0)) - best_acc) < 1e-9:
-                    best_params = ckpt.restore_into(fp, jax.device_get(params))[0]
-                    recovered = True
-            if best_acc > 0 and not recovered:
+            # never beats the old best still saves/evaluates a best model
+            # (_final_best_payload owns the matching-best_acc contract)
+            fp = (_final_best_payload(cfg, best_acc, log)
+                  if best_acc > 0 else None)
+            if fp is not None:
+                best_params = ckpt.restore_into(fp, jax.device_get(params))[0]
+            elif best_acc > 0:
                 best_acc = 0.0      # no matching best params: restart tracking
 
     # Both keys derive from cfg.seed: every process of a multi-host run MUST
@@ -465,20 +601,23 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     # ---- resilience subsystem (divergence rollback, preemption-safe
     # shutdown, hung-step watchdog, fault injection) ----
     resil = None
-    if cfg.resilience == "on" and not multi_host:
+    if cfg.resilience == "on" and (not multi_host or coordinator is not None):
         resil = resilience.ResilienceManager(cfg, log, start_epoch=start_epoch,
-                                             retry_nonce=retry_nonce)
+                                             retry_nonce=retry_nonce,
+                                             coord=coordinator)
         # host snapshot of the fresh/resumed state: the rollback target
-        # until the first periodic checkpoint exists
+        # until the first periodic checkpoint exists (under coordination,
+        # every rank keeps one — the '<initial state>' source restores it
+        # rank-locally, params being replicated)
         resil.set_initial_snapshot(jax.device_get(params),
                                    jax.device_get(opt_state),
                                    jax.device_get(state))
         resil.start()
     elif cfg.resilience == "on":
-        log("[resilience] multi-host run: in-process divergence rollback/"
-            "watchdog disabled (coordinated abort across ranks is a ROADMAP "
-            "follow-up); the checkpoint integrity chain still protects "
-            "rank-0 resume")
+        log("[resilience] multi-host run with --coord off: in-process "
+            "divergence rollback/watchdog disabled (agreed abort/rollback "
+            "needs the rank coordinator — drop --coord off); the "
+            "checkpoint integrity chain still protects rank-0 resume")
     if resil is None and (cfg.inject or os.environ.get("BNSGCN_FAULT")):
         log("[resilience] WARNING: --inject is armed but the resilience "
             "loop is disabled here — no fault will fire")
@@ -601,7 +740,72 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             if (resil is not None and not bad
                     and (epoch + 1) % cfg.log_every == 0):
                 bad = not math.isfinite(float(param_global_norm(params)))
-            if bad:
+            if resil is not None and resil.coord is not None:
+                # ---- multi-host agreed verdict: every rank contributes
+                # its local state out-of-band, rank 0 reduces worst-wins,
+                # and ALL ranks act on the one decision — a SIGTERM or NaN
+                # on a single rank can no longer strand its peers inside
+                # the next collective ----
+                local = ("diverged" if bad
+                         else "preempted" if resil.preempt_requested
+                         else "ok")
+                decision = resil.agree_step(epoch, local, loss_f)
+                act = decision["decision"]
+                if act == "abort":
+                    resil.raise_abort(decision)
+                if act == "preempt":
+                    # agreed all-rank resumable shutdown: rank 0 writes the
+                    # checkpoint (the agree() confirm phase already
+                    # guaranteed every rank has read the verdict)
+                    ppath = ckpt.periodic_path(cfg, epoch)
+                    if is_rank0:
+                        ckpt.save_checkpoint(ppath, params=params,
+                                             opt_state=opt_state,
+                                             bn_state=state, epoch=epoch,
+                                             best_acc=best_acc, seed=seed,
+                                             extra={"retry_nonce": retry_nonce})
+                        ckpt.prune_checkpoints(cfg, cfg.keep_ckpt)
+                    log(f"[resilience] agreed preemption (requested by "
+                        f"rank(s) {decision.get('ranks')}) at the epoch-"
+                        f"{epoch} step boundary: resumable checkpoint at "
+                        f"{ppath}")
+                    raise resilience.PreemptedError(epoch, ppath)
+                if act == "rollback":
+                    templates = (jax.device_get(params),
+                                 jax.device_get(opt_state),
+                                 jax.device_get(state))
+                    if multi_host:
+                        # real pod: rank 0 restores its validated payload
+                        # and the trees travel the proven XLA broadcast.
+                        # Every rank joins the restore ack FIRST — a rank-0
+                        # restore failure must abort all ranks (78) before
+                        # anyone blocks inside the XLA collective
+                        from jax.experimental import multihost_utils
+                        host = resil.coord_restore(decision, *templates,
+                                                   restore_local=is_rank0)
+                        p_h, o_h, s_h = multihost_utils.broadcast_one_to_all(
+                            host)
+                    else:
+                        # harness mode: each rank restores the agreed source
+                        # from its own checkpoint dir and acks — a torn
+                        # local copy aborts ALL ranks loudly (exit 78)
+                        p_h, o_h, s_h = resil.coord_restore(decision,
+                                                            *templates)
+                    restart = int(decision["restart"])
+                    retry_nonce = int(decision["nonce"])
+                    params = place_replicated(p_h, mesh)
+                    opt_state = place_replicated(o_h, mesh)
+                    state = place_replicated(s_h, mesh)
+                    sample_key, drop_key = _fold_keys(retry_nonce)
+                    if restart < loss_base:
+                        res.losses.clear()
+                        loss_base = restart
+                    else:
+                        del res.losses[restart - loss_base:]
+                    resil.watchdog.touch()      # restore+ack was boundary
+                    epoch = restart             # work, not step time
+                    continue
+            elif bad:
                 p_h, o_h, s_h, restart, retry_nonce = resil.rollback(
                     epoch, loss_f, jax.device_get(params),
                     jax.device_get(opt_state), jax.device_get(state))
@@ -761,8 +965,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # ---- preemption-safe shutdown: the SIGTERM/SIGINT flag is read
             # at the step boundary only — mid-step device state is never
             # torn. The resumable checkpoint carries seed + retry nonce, so
-            # --resume continues the exact sampling/dropout streams. ----
-            if resil is not None and resil.preempt_requested:
+            # --resume continues the exact sampling/dropout streams. Under
+            # coordination the flag already went through the agreed-verdict
+            # exchange above (a signal landing after it waits one epoch). ----
+            if (resil is not None and resil.coord is None
+                    and resil.preempt_requested):
                 ppath = ckpt.periodic_path(cfg, epoch)
                 if is_rank0 and not wrote_ckpt:
                     ckpt.save_checkpoint(ppath, params=params,
@@ -793,6 +1000,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         if resil is not None:
             res.rollbacks = list(resil.rollbacks)
             resil.close()
+        if coordinator is not None:
+            # terminal decisions (preempt/abort) were already confirmed by
+            # every peer inside agree(); a NORMAL completion still needs a
+            # barrier, or rank 0 could tear the server down while a peer —
+            # up to one step boundary behind — is fetching its last verdict
+            if sys.exc_info()[0] is None:
+                coordinator.finish()
+            coordinator.close()
         if sys.exc_info()[0] is not None:
             # propagate without waiting on a queued eval. An in-flight eval
             # still runs in its (non-daemon) worker; the CLI preemption path

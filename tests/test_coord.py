@@ -1,0 +1,354 @@
+"""Unit tests for the rank-coordination layer (parallel/coord.py).
+
+Two Coordinator instances driven from threads stand in for two ranks: the
+layer needs no jax and no XLA collectives, so every exchange — agree,
+broadcast, gather_ok, liveness, timeout — is provable in-process. The real
+2-subprocess contract (exit codes, bit-for-bit resume) lives in
+tests/test_coord_e2e.py.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from bnsgcn_tpu import resilience
+from bnsgcn_tpu.config import Config, parse_config
+from bnsgcn_tpu.parallel.coord import (Coordinator, CoordTimeout,
+                                       FileTransport, TcpTransport,
+                                       make_coordinator, reduce_states)
+
+
+def _pair(transport_factory, timeout_s=10.0):
+    t0 = transport_factory(0, serve=True)
+    t1 = transport_factory(1, serve=False)
+    return (Coordinator(0, 2, t0, timeout_s, log=lambda *a: None),
+            Coordinator(1, 2, t1, timeout_s, log=lambda *a: None))
+
+
+def _run2(f0, f1):
+    """Run the two ranks' halves concurrently; return their results."""
+    out, errs = {}, {}
+
+    def wrap(rank, fn):
+        try:
+            out[rank] = fn()
+        except Exception as ex:          # surfaced by the assert below
+            errs[rank] = ex
+
+    ts = [threading.Thread(target=wrap, args=(r, f))
+          for r, f in ((0, f0), (1, f1))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs, errs
+    return out[0], out[1]
+
+
+@pytest.fixture(params=["tcp", "file"])
+def coord_pair(request, tmp_path):
+    if request.param == "tcp":
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        c0, c1 = _pair(lambda r, serve: TcpTransport("127.0.0.1", port, serve))
+    else:
+        c0, c1 = _pair(
+            lambda r, serve: FileTransport(str(tmp_path / "coord"), r))
+    yield c0, c1
+    c0.close()
+    c1.close()
+
+
+# ----------------------------------------------------------------------------
+# verdict reduce
+# ----------------------------------------------------------------------------
+
+def test_reduce_states_worst_wins():
+    assert reduce_states({0: "ok", 1: "ok"}) == "ok"
+    assert reduce_states({0: "ok", 1: "preempted"}) == "preempt"
+    # diverged outranks preempted: a preempt checkpoint written from NaN
+    # state would poison the resume
+    assert reduce_states({0: "preempted", 1: "diverged"}) == "rollback"
+    assert reduce_states({0: "abort", 1: "diverged"}) == "abort"
+    assert reduce_states({0: "ok", 1: "garbage"}) == "abort"
+
+
+# ----------------------------------------------------------------------------
+# collectives over both transports
+# ----------------------------------------------------------------------------
+
+def test_agree_ok_and_rollback_payload(coord_pair):
+    c0, c1 = coord_pair
+    d0, d1 = _run2(lambda: c0.agree(4, "ok"), lambda: c1.agree(4, "ok"))
+    assert d0["decision"] == d1["decision"] == "ok"
+
+    def decide(name, states):
+        assert name == "rollback" and states == {0: "ok", 1: "diverged"}
+        return {"decision": "rollback", "restart": 2, "nonce": 1,
+                "source": "x.ckpt", "backoff_s": 0.0}
+
+    d0, d1 = _run2(lambda: c0.agree(5, "ok", decide),
+                   lambda: c1.agree(5, "diverged"))
+    assert d0 == d1
+    assert (d1["decision"], d1["restart"], d1["nonce"]) == ("rollback", 2, 1)
+    assert d1["epoch"] == 5              # filled in when decide omits it
+
+
+def test_agree_preempt_confirms_on_both_ranks(coord_pair):
+    c0, c1 = coord_pair
+
+    def decide(name, states):
+        return {"decision": "preempt",
+                "ranks": [r for r, s in states.items() if s == "preempted"]}
+
+    d0, d1 = _run2(lambda: c0.agree(3, "preempted", decide),
+                   lambda: c1.agree(3, "ok"))
+    assert d0["decision"] == d1["decision"] == "preempt"
+    assert d1["ranks"] == [0]
+
+
+def test_broadcast_and_gather_ok(coord_pair):
+    c0, c1 = coord_pair
+    b0, b1 = _run2(lambda: c0.broadcast("seed", {"seed": 77}),
+                   lambda: c1.broadcast("seed"))
+    assert b0 == b1 == {"seed": 77}
+
+    g0, g1 = _run2(lambda: c0.gather_ok("resume", True),
+                   lambda: c1.gather_ok("resume", True))
+    assert g0 == g1 == (True, {})
+
+    g0, g1 = _run2(lambda: c0.gather_ok("resume", True),
+                   lambda: c1.gather_ok("resume", False, "torn file"))
+    assert g0 == g1 == (False, {1: "torn file"})
+
+
+def test_liveness_reports_epoch_and_age(coord_pair):
+    c0, c1 = coord_pair
+    c0.heartbeat(7)
+    c1.heartbeat(6)
+    c1.heartbeat(0, c1.ALIVE_KEY)
+    live = c0.liveness()
+    assert live[0]["epoch"] == 7 and live[1]["epoch"] == 6
+    assert live[0]["step_age_s"] < 5.0
+    assert "alive_age_s" in live[1] and "alive_age_s" not in live[0]
+    lines = []
+    c0.log_liveness(write=lines.append)
+    text = "\n".join(lines)
+    assert "rank 0" in text and "rank 1" in text and "epoch 6" in text
+
+
+def test_log_liveness_invents_no_culprit_before_any_heartbeat(coord_pair):
+    # a startup failure (before ANY rank heartbeats) has no straggler to
+    # name: every age is inf and the dump must not arbitrarily mark rank 0
+    c0, _ = coord_pair
+    lines = []
+    c0.log_liveness(write=lines.append)
+    text = "\n".join(lines)
+    assert "rank 0" in text and "stalled" not in text
+
+
+def test_log_liveness_names_the_rank_that_never_reported(coord_pair):
+    c0, _ = coord_pair
+    c0.heartbeat(3)                     # rank 0 reported; rank 1 never did
+    lines = []
+    c0.log_liveness(write=lines.append)
+    stalled = [ln for ln in lines if "stalled" in ln]
+    assert len(stalled) == 1 and "rank 1" in stalled[0]
+
+
+def test_heartbeat_swallows_transport_oserror():
+    # FileTransport.put hits the raw filesystem: ENOSPC / flaky NFS must
+    # not take down the rank healthy enough to send a heartbeat
+    class _Broken:
+        def put(self, *a):
+            raise OSError("no space left on device")
+
+    c = Coordinator(0, 2, _Broken(), 1.0, log=lambda *a: None)
+    c.heartbeat(4)                      # must not raise
+
+
+def test_peer_decision_window_covers_slow_rank0_decide(coord_pair):
+    # rank 0's decide_fn does real checkpoint I/O (chain walk + checksums);
+    # a healthy decide that outlives ONE exchange timeout must not make the
+    # peer cry hang — the peer's decision fetch allows 2x
+    c0, c1 = coord_pair
+    c0.timeout_s = c1.timeout_s = 1.0
+
+    def decide(name, states):
+        time.sleep(1.4)
+        return {"decision": "rollback", "restart": 1, "nonce": 1}
+
+    d0, d1 = _run2(lambda: c0.agree(6, "diverged", decide),
+                   lambda: c1.agree(6, "ok"))
+    assert d0 == d1 and d1["restart"] == 1
+
+
+def test_spent_exchange_keys_are_pruned(coord_pair):
+    # one agree per epoch for a run's whole lifetime must not grow the KV
+    # store (or the --coord file dir the liveness dump listdir's) without
+    # bound: rank 0 deletes a spent exchange's per-seq keys once they fall
+    # past the prune horizon
+    c0, c1 = coord_pair
+    n = Coordinator.PRUNE_HORIZON + 4
+    for e in range(n):
+        _run2(lambda: c0.agree(e, "ok"), lambda: c1.agree(e, "ok"))
+    dl = time.monotonic() + 5
+    seqs = {int(k.split("/")[1]) for k in c0.transport.dump("v/", dl)}
+    assert max(seqs) == n - 1           # the live tail is intact
+    assert min(seqs) >= n - Coordinator.PRUNE_HORIZON
+    assert c0.transport.dump("d/", dl).keys() == {f"d/{s}" for s in seqs}
+
+
+def test_get_times_out_with_bounded_wait(coord_pair):
+    c0, c1 = coord_pair
+    c0.timeout_s = 1.0
+    t0 = time.monotonic()
+    with pytest.raises(CoordTimeout, match="rank 1"):
+        c0.agree(9, "ok")               # rank 1 never contributes
+    waited = time.monotonic() - t0
+    assert 0.9 <= waited < 5.0          # bounded: no way to hang forever
+
+
+def test_tcp_client_times_out_when_no_server():
+    t = TcpTransport("127.0.0.1", 1, serve=False)   # nothing listens on :1
+    c = Coordinator(1, 2, t, 1.0, log=lambda *a: None)
+    t0 = time.monotonic()
+    with pytest.raises(CoordTimeout):
+        c.broadcast("seed")
+    assert time.monotonic() - t0 < 5.0
+
+
+# ----------------------------------------------------------------------------
+# construction / resolution
+# ----------------------------------------------------------------------------
+
+def test_make_coordinator_off_and_single_rank_are_none():
+    # every one of these must construct NOTHING: the --coord off /
+    # single-rank paths are pinned bit-identical to the pre-coordinator loop
+    for cfg in (Config(coord="off", coord_world=2, coord_rank=0),
+                Config(coord="auto"),
+                Config(coord="tcp")):
+        c, rank, world = make_coordinator(cfg, log=lambda *a: None)
+        assert c is None, cfg.coord
+
+
+def test_make_coordinator_auto_resolves_tcp_for_multi_rank(tmp_path):
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = Config(coord="auto", coord_world=2, coord_rank=0, coord_port=port,
+                 coord_addr="127.0.0.1")
+    c, rank, world = make_coordinator(cfg, log=lambda *a: None)
+    assert c is not None and (rank, world) == (0, 2)
+    assert isinstance(c.transport, TcpTransport)
+    c.close()
+    cfg = Config(coord="file", coord_world=2, coord_rank=1,
+                 coord_dir=str(tmp_path / "c"))
+    c, rank, world = make_coordinator(cfg, log=lambda *a: None)
+    assert isinstance(c.transport, FileTransport) and rank == 1
+    c.close()
+
+
+def test_file_transport_new_run_never_reads_stale_keys(tmp_path):
+    """The coord dir outlives a run and sequence numbers restart at 0: a
+    resumed run must never see the previous run's keys (e.g. adopt a stale
+    'preempt' decision at the same seq). Rank 0 purges + re-namespaces."""
+    root = str(tmp_path / "coord")
+    run1 = FileTransport(root, 0)
+    run1.put("d/5", "stale-preempt", time.monotonic() + 5)
+    t0 = FileTransport(root, 0)         # the relaunch
+    t1 = FileTransport(root, 1)
+    assert t0.try_get("d/5", time.monotonic() + 5) is None
+    assert t1.try_get("d/5", time.monotonic() + 5) is None
+    t0.put("d/0", "fresh", time.monotonic() + 5)
+    assert t1.try_get("d/0", time.monotonic() + 5) == "fresh"
+    assert t1.dump("d/", time.monotonic() + 5).keys() == {"d/0"}
+
+
+def test_file_transport_peer_refuses_dead_previous_runs_boot(tmp_path):
+    """Requeue race: run 2's peer starts BEFORE run 2's rank 0 purges. The
+    previous run's .boot AND its keys (same deterministic names, e.g. the
+    seq-0 seed broadcast) are still on disk — the peer must not adopt the
+    dead run's namespace and read its stale seed; it polls until the new
+    rank 0 mints, then converges on the fresh keys."""
+    import subprocess
+    from bnsgcn_tpu.parallel import coord as coord_mod
+    root = tmp_path / "coord"
+    root.mkdir()
+    p = subprocess.Popen(["true"])
+    p.wait()                            # reaped: os.kill(pid, 0) now fails
+    dead = f"{coord_mod._host()}:{p.pid:x}-1"
+    (root / FileTransport.BOOT).write_text(dead)
+    (root / f"{dead}@b@seed@0").write_text('{"seed": 1234}')
+    t1 = FileTransport(str(root), 1)
+    with pytest.raises(CoordTimeout):   # never adopts the dead namespace
+        t1.try_get("b/seed/0", time.monotonic() + 0.3)
+    t0 = FileTransport(str(root), 0)    # the new rank 0 arrives
+    t0.put("b/seed/0", '{"seed": 77}', time.monotonic() + 5)
+    assert t1.try_get("b/seed/0", time.monotonic() + 5) == '{"seed": 77}'
+
+
+def test_coord_world_requires_explicit_in_range_rank():
+    # defaulting a missing rank to 0 would make every misconfigured peer a
+    # serving rank 0 (split-brain) — it must be a named config error
+    with pytest.raises(ValueError, match="coord-rank"):
+        make_coordinator(Config(coord="tcp", coord_world=2),
+                         log=lambda *a: None)
+    with pytest.raises(ValueError, match="out of range"):
+        make_coordinator(Config(coord="tcp", coord_world=2, coord_rank=2),
+                         log=lambda *a: None)
+
+
+def test_harness_without_coordination_needs_skip_partition(tmp_path):
+    # --coord-world > 1 with coordination disabled has NO cross-process
+    # partition barrier: main must refuse (exit 2) instead of letting two
+    # builders race on the shared artifact dir
+    from bnsgcn_tpu.main import main
+    with pytest.raises(SystemExit) as ex:
+        main(["--dataset", "sbm", "--n-partitions", "2",
+              "--coord-world", "2", "--coord-rank", "1",
+              "--resilience", "off",
+              "--part-path", str(tmp_path / "p")])
+    assert ex.value.code == 2
+
+
+def test_coord_flags_reach_config():
+    cfg = parse_config(["--coord", "file", "--coord-dir", "/x",
+                        "--coord-rank", "1", "--coord-world", "2",
+                        "--coord-port", "19999", "--coord-addr", "h0"])
+    assert (cfg.coord, cfg.coord_dir, cfg.coord_rank, cfg.coord_world,
+            cfg.coord_port, cfg.coord_addr) == ("file", "/x", 1, 2, 19999,
+                                                "h0")
+
+
+# ----------------------------------------------------------------------------
+# rank-targeted inject grammar (satellite)
+# ----------------------------------------------------------------------------
+
+def test_inject_rank_targeting_filters_by_rank():
+    spec = "nan@E5:r0,sigterm@E3:r1,hang@E2"
+    assert resilience.FaultPlan.parse(spec, rank=0).faults == {
+        "nan": {5}, "hang": {2}}
+    assert resilience.FaultPlan.parse(spec, rank=1).faults == {
+        "sigterm": {3}, "hang": {2}}
+    # rank-less form keeps its historical all-ranks meaning
+    assert resilience.FaultPlan.parse("nan@E4", rank=3).faults == {"nan": {4}}
+
+
+@pytest.mark.parametrize("bad", ["nan@E5:1", "nan@E5:rx", "nan@E5:r",
+                                 "nan@E5:r-1", "nan@E5r1"])
+def test_inject_rank_grammar_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        resilience.FaultPlan.parse(bad, rank=0)
+
+
+def test_inject_terms_for_other_ranks_still_validated():
+    # a typo'd term must raise even when it targets a different rank —
+    # silently dropping it would make a CI fault run vacuously green
+    with pytest.raises(ValueError):
+        resilience.FaultPlan.parse("oom@E3:r1", rank=0)

@@ -1,17 +1,20 @@
 #!/bin/bash
 # Deterministic fault-injection matrix through the real CLI — the shell
-# twin of tests/test_resilience_e2e.py, runnable on any host (CPU mesh by
-# default) or on hardware before a long window: each of the four --inject
-# kinds must recover via its designed path / exit code, and a
-# sigterm-interrupted + resumed run must reach the uninterrupted run's
-# final loss.
+# twin of tests/test_resilience_e2e.py + tests/test_coord_e2e.py, runnable
+# on any host (CPU mesh by default) or on hardware before a long window:
+# each of the four --inject kinds must recover via its designed path / exit
+# code, a sigterm-interrupted + resumed run must reach the uninterrupted
+# run's final loss, and the MULTI-HOST stages drive two real coordinated
+# rank processes (--coord tcp, no XLA collectives needed) through partial
+# SIGTERM and coordinated NaN rollback.
 #
 #   JAX_PLATFORMS=cpu tools/fault_matrix.sh [workdir]
 #
 # Exit-code contract (bnsgcn_tpu/resilience.py, README "Fault tolerance"):
 #   75  preempted, resumable checkpoint written (relaunch with --resume)
 #   76  divergence unrecovered after --resil-retries rollbacks
-#   77  hung step: watchdog dumped stacks and killed the process
+#   77  hung step / coordinator exchange timeout (peer liveness on stderr)
+#   78  coordinated abort: a rank cannot load the agreed checkpoint
 set -u
 cd "$(dirname "$0")/.."
 WORK=${1:-$(mktemp -d /tmp/bnsgcn_faults.XXXXXX)}
@@ -84,6 +87,64 @@ BNSGCN_WATCHDOG_MIN_S=1.5 BNSGCN_WATCHDOG_FACTOR=2 \
 check hang 77 $?
 grep -q 'watchdog' "$WORK/hang.log" \
   || { echo "FAIL  hang: no watchdog dump"; FAIL=1; }
+
+# ---- multi-host stages: two real coordinated rank processes. The
+# coordinator is XLA-free, so these run on the CPU container where jaxlib
+# refuses multiprocess collectives; each process is a full single-host
+# trainer (same broadcast seed => bit-identical state) coupled only through
+# the --coord tcp channel. ----
+COORD_PORT=${COORD_PORT:-19119}
+run_pair() {  # run_pair <tag> <ckpt0> <ckpt1> [extra args...]
+  local tag=$1 ck0=$2 ck1=$3; shift 3
+  python -m bnsgcn_tpu.main $BASE --skip-partition --ckpt-path "$ck0" \
+    --coord tcp --coord-port "$COORD_PORT" --coord-world 2 --coord-rank 0 \
+    "$@" > "$WORK/${tag}_r0.log" 2>&1 &
+  local P0=$!
+  python -m bnsgcn_tpu.main $BASE --skip-partition --ckpt-path "$ck1" \
+    --coord tcp --coord-port "$COORD_PORT" --coord-world 2 --coord-rank 1 \
+    "$@" > "$WORK/${tag}_r1.log" 2>&1 &
+  local P1=$!
+  wait $P0; RC0=$?
+  wait $P1; RC1=$?
+  COORD_PORT=$((COORD_PORT + 2))
+}
+
+echo "== multi-host: sigterm@E3 on rank 1 only -> agreed exit 75 on both =="
+run_pair mh_sig "$WORK/ck_mh" "$WORK/ck_mh" --inject sigterm@E3:r1
+check mh_sig_r0 75 $RC0
+check mh_sig_r1 75 $RC1
+grep -q 'agreed preemption' "$WORK/mh_sig_r0.log" \
+  || { echo "FAIL  mh_sig: no agreed-preemption line"; FAIL=1; }
+
+echo "== multi-host: --resume both ranks matches the uninterrupted loss =="
+run_pair mh_res "$WORK/ck_mh" "$WORK/ck_mh" --resume --seed 999
+check mh_res_r0 0 $RC0
+check mh_res_r1 0 $RC1
+for r in 0 1; do
+  MH_LOSS=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/mh_res_r$r.log" | cut -d= -f2)
+  if [ "$REF_LOSS" != "$MH_LOSS" ]; then
+    echo "FAIL  mh_res_r$r: final loss $MH_LOSS != uninterrupted $REF_LOSS"
+    FAIL=1
+  else
+    echo "PASS  mh_res_r$r loss matches uninterrupted ($MH_LOSS)"
+  fi
+done
+
+echo "== multi-host: nan@E5 on rank 0 -> coordinated rollback, same nonce =="
+run_pair mh_nan "$WORK/ck_mhn" "$WORK/ck_mhn" --inject nan@E5:r0
+check mh_nan_r0 0 $RC0
+check mh_nan_r1 0 $RC1
+grep -q 'agreed rollback to' "$WORK/mh_nan_r0.log" \
+  || { echo "FAIL  mh_nan: rank 0 did not decide a rollback"; FAIL=1; }
+grep -q 'agreed rollback (decided by rank 0)' "$WORK/mh_nan_r1.log" \
+  || { echo "FAIL  mh_nan: rank 1 did not apply the agreed rollback"; FAIL=1; }
+L0=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/mh_nan_r0.log" | cut -d= -f2)
+L1=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/mh_nan_r1.log" | cut -d= -f2)
+if [ -z "$L0" ] || [ "$L0" != "$L1" ]; then
+  echo "FAIL  mh_nan: rank losses diverged ('$L0' vs '$L1')"; FAIL=1
+else
+  echo "PASS  mh_nan ranks agree on the healed loss ($L0)"
+fi
 
 [ $FAIL -eq 0 ] && echo "fault matrix: ALL PASS ($WORK)" \
   || echo "fault matrix: FAILURES (logs in $WORK)"

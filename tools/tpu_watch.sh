@@ -1,5 +1,6 @@
 #!/bin/bash
-# SUPERSEDED by tools/tpu_watchdog4.sh (round 5) — kept as round-history only.
+# SUPERSEDED by tools/tpu_watchdog5.sh (tpu_watchdog{,2,3,4}.sh are deleted;
+# liveness now lives in-process, resilience.py) — kept as round-history only.
 # TPU tunnel watcher: probe the backend every 60s for up to ~9.5 min.
 # Exit 0 the moment a TPU backend answers; exit 2 if the window stayed shut.
 # Launched repeatedly in the background so work can proceed while waiting.

@@ -1,28 +1,33 @@
 #!/bin/bash
-# NOTE (resilience PR): hung-STEP detection now lives in-process
-# (bnsgcn_tpu/resilience.py — watchdog exits 77 with stack dumps; SIGTERM
-# preemption exits 75 resumable). A relaunch wrapper should requeue on exit
-# codes 75/77 rather than liveness-polling the python process; this script's
-# remaining job is bench-queue orchestration (cursor, requeue, best_known).
+# Bench-queue driver, exit-code edition. Liveness detection is fully
+# in-process now (bnsgcn_tpu/resilience.py + parallel/coord.py): a hung
+# step or dead coordinator exits 77 with stacks/peer-liveness on stderr, a
+# preemption exits 75 with a resumable checkpoint, exhausted divergence
+# rollbacks exit 76, a coordinated abort exits 78. This wrapper therefore
+# REQUEUES ON EXIT CODES instead of polling `jax.devices()` liveness (the
+# tpu_watchdog{,2,3,4}.sh role, deleted with this change — see ROADMAP):
 #
-# Round-5 mid-session watchdog: the container restarted at ~07:05 UTC and
-# killed tpu_watchdog4 mid-queue (run[1] had just started; bench_cache was
-# wiped with the container). The tunnel is UP and the round-4 headline was
-# already REPRODUCED this round (hw_logs/r5_confirm.log, 0.5715 s/epoch at
-# 03:43), so this watchdog skips the confirm stage and drains .watch_queue
-# immediately, then re-measures whatever holds best_known so the final
-# headline is backed by >=2 fresh runs. Logs go to hw_logs/.
+#   75  preempted         -> requeue immediately (the relaunch resumes)
+#   76  diverged          -> requeue once, flag for triage in the status file
+#   77  hung / coord-dead -> brief backoff (the platform may be mid-restart),
+#                            then requeue
+#   78  coordinated abort -> NO requeue: a rank cannot load the agreed
+#                            checkpoint; human triage required
+#
+# Queue mechanics are unchanged from the round-5 driver: physical-line
+# cursor in .watch_queue.cursor (delete it when rewriting the queue),
+# single-instance flock, fresh-measurement detection via the bench JSON
+# status field, and a best_known reproduction pass once the queue drains.
 cd /root/repo
 DEADLINE=$(( $(date +%s) + ${1:-43200} ))   # default: up to 12h
 QUEUE=/root/repo/.watch_queue
-STATUS=/root/repo/hw_logs/r5_watchdog5_status
+STATUS=/root/repo/hw_logs/watchdog5_status
 LOGDIR=/root/repo/hw_logs
 mkdir -p "$LOGDIR"
 touch "$QUEUE"
 RAN_ANY=0    # set only when a bench run took a FRESH measurement — gates repro
 # Per-launch log stamp: a relaunch after a container restart must never
-# truncate the previous session's evidence logs (they are the committed
-# audit trail for the headline numbers).
+# truncate the previous session's evidence logs.
 STAMP=$(date -u +%H%M%S)
 # Single instance only: two drains with independent cursors would run
 # bench.py concurrently on the one chip and corrupt each other's timings.
@@ -32,25 +37,18 @@ if ! flock -n 9; then
     >> "$STATUS"
   exit 1
 fi
-# Queue cursor persists across same-container relaunches so a relaunch
-# does not replay already-measured lines. (A full container restart
-# reverts the repo to the git checkout and loses it — by then the queue
-# itself needs human re-triage anyway.) Delete the cursor file when
-# rewriting the queue from scratch.
 CURSOR=/root/repo/.watch_queue.cursor
 DONE_N=$(cat "$CURSOR" 2>/dev/null || echo 0)
 case "$DONE_N" in ''|*[!0-9]*) DONE_N=0;; esac
-# When a run ends with no fresh measurement (tunnel died mid-run), its
-# line is re-appended to the queue; the budget caps how much window a
-# deterministically-failing line can burn (preflight makes that rare).
+# Requeues are budgeted so a deterministically-failing line cannot burn the
+# whole window.
 RETRY_BUDGET=12
 
 # bench.py's supervisor exits 0 even on its carried-forward fallback, so rc
 # alone cannot distinguish "measured on hardware" from "emitted stale data".
 # A clean run's final JSON line has no "status" field; status="partial"
 # means a worker DID measure something this run and then failed (fresh);
-# "tpu-unavailable"/"carried-forward"/"profiled-diagnostic" mean no fresh
-# gated measurement landed.
+# anything else means no fresh gated measurement landed.
 fresh_ok() {
   local last
   last=$(grep '"metric"' "$1" 2>/dev/null | tail -1)
@@ -62,44 +60,50 @@ fresh_ok() {
   fi
 }
 
-# status="partial": a worker measured SOMETHING this run and then failed —
-# fresh for best_known purposes, but the line's remaining candidates were
-# never reached, so the line also goes back in the queue (retry-budgeted).
 partial_run() {
   grep '"metric"' "$1" 2>/dev/null | tail -1 \
     | grep -q '"status": *"partial"'
 }
 
 # The queue is appended by humans and by this script; a final line missing
-# its trailing newline would otherwise merge with the next append (and the
-# awk/sed physical-line cursor would silently skip a run).
+# its trailing newline would otherwise merge with the next append.
 ensure_queue_newline() {
   if [ -s "$QUEUE" ] && [ -n "$(tail -c1 "$QUEUE")" ]; then
     printf '\n' >> "$QUEUE"
   fi
 }
 
-alive() {
-  timeout 180 python -c \
-    "import jax; assert jax.devices() and jax.default_backend() == 'tpu'" \
-    >/dev/null 2>&1
+requeue_line() {  # requeue_line <line> <why>
+  if [ "$RETRY_BUDGET" -gt 0 ]; then
+    RETRY_BUDGET=$((RETRY_BUDGET - 1))
+    ensure_queue_newline
+    printf '%s\n' "$1" >> "$QUEUE"
+    echo "requeued ($2; retry budget $RETRY_BUDGET)" >> "$STATUS"
+  else
+    echo "retry budget exhausted; dropping line ($2)" >> "$STATUS"
+  fi
 }
 
-wait_alive() {
-  while true; do
-    if alive; then echo "ALIVE $(date -u +%H:%M:%S)" >> "$STATUS"; return 0; fi
-    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
-      echo "DEADLINE $(date -u +%H:%M:%S)" >> "$STATUS"; exit 1
-    fi
-    echo "down $(date -u +%H:%M:%S)" >> "$STATUS"
-    sleep 120
-  done
+# Exit-code-driven requeue policy — replaces the old alive()/wait_alive()
+# liveness polling entirely.
+handle_rc() {  # handle_rc <rc> <line> ; returns 0 when the line was handled
+  case "$1" in
+    75) requeue_line "$2" "exit 75 preempted: relaunch resumes"; return 0;;
+    76) echo "TRIAGE exit 76 (divergence) on: $2" >> "$STATUS"
+        requeue_line "$2" "exit 76 diverged"; return 0;;
+    77) echo "exit 77 (hung/coordinator timeout); backing off 120s" \
+          >> "$STATUS"
+        sleep 120
+        requeue_line "$2" "exit 77 hung"; return 0;;
+    78) echo "TRIAGE exit 78 (coordinated abort — checkpoint state needs a "\
+"human) on: $2; NOT requeued" >> "$STATUS"; return 0;;
+  esac
+  return 1
 }
 
 # Outer timeout must exceed bench.py's own envelope (hard timeout =
-# --budget-s + 1500, probe retries counted inside it) or the watchdog kills
-# runs bench's own timeout policy was designed to finish. Queue lines carry
-# their own --budget-s, so derive the outer timeout per line.
+# --budget-s + 1500) or the wrapper kills runs bench's own timeout policy
+# was designed to finish. Queue lines carry their own --budget-s.
 bench_timeout_for() {
   local budget
   budget=$(printf '%s\n' "$1" | sed -n 's/.*--budget-s[= ]\([0-9]*\).*/\1/p')
@@ -107,11 +111,8 @@ bench_timeout_for() {
   echo $((budget + 1800))
 }
 
-# Headline best_known spmm — exact headline tag, NOT a startswith scan: the
-# queue also writes dcsbm-mid_0.5_492 and dcsbm_0.5_492_gat entries, and a
-# prefix match could disarm the repro on the wrong workload's spmm. The
-# json read never needs the TPU backend: force CPU + timeout so a wedged
-# tunnel can't hang the command substitution forever.
+# Headline best_known spmm — exact headline tag, NOT a startswith scan. The
+# json read never needs the TPU backend: force CPU + timeout.
 best_spmm() {
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu timeout 60 \
     python - <<'EOF'
@@ -130,41 +131,28 @@ REPRO_TRIES=0
 ri=1
 i=1
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  # Physical line count (awk NR) to match the sed physical-line cursor: blank
-  # lines advance DONE_N too (round-4 advisor finding on tpu_watchdog3), and
-  # a final line without a trailing newline still counts.
   TOTAL=$(awk 'END{print NR}' "$QUEUE")
   if [ "$TOTAL" -le "$DONE_N" ]; then
     # Queue drained. Reproduce the current headline best once (it needs >=2
     # runs), then keep polling for appended lines.
     if [ "$REPRO_DONE" -eq 0 ] && [ "$RAN_ANY" -eq 1 ] \
        && [ "$REPRO_TRIES" -lt 3 ]; then
-      # Headline workload = the dcsbm clustered graph. Plain "ell" is the
-      # anchor, not a --candidates name — an anchor-held best is reproduced
-      # by any run's anchor stage, so run without --candidates/--skip-anchor.
-      # The json read never needs the TPU backend: force CPU + timeout so a
-      # wedged tunnel can't hang the command substitution forever.
       BEST=$(best_spmm)
       if [ -n "$BEST" ]; then
-        wait_alive
         echo "repro[$ri][$BEST] start $(date -u +%H:%M:%S)" >> "$STATUS"
         if [ "$BEST" = "ell" ]; then
           timeout "$(bench_timeout_for '--budget-s 1800')" python bench.py \
-            --epochs 8 --budget-s 1800 > "$LOGDIR/r5w5_${STAMP}_repro_$ri.log" 2>&1
+            --epochs 8 --budget-s 1800 > "$LOGDIR/w5_${STAMP}_repro_$ri.log" 2>&1
         else
           timeout "$(bench_timeout_for '--budget-s 1800')" python bench.py \
             --epochs 8 --skip-anchor --candidates "$BEST" --budget-s 1800 \
-            > "$LOGDIR/r5w5_${STAMP}_repro_$ri.log" 2>&1
+            > "$LOGDIR/w5_${STAMP}_repro_$ri.log" 2>&1
         fi
         rc=$?
-        FRESH=$(fresh_ok "$LOGDIR/r5w5_${STAMP}_repro_$ri.log" && echo 1 || echo 0)
+        FRESH=$(fresh_ok "$LOGDIR/w5_${STAMP}_repro_$ri.log" && echo 1 || echo 0)
         echo "repro[$ri] rc=$rc fresh=$FRESH" >> "$STATUS"
         ri=$((ri + 1))
         REPRO_TRIES=$((REPRO_TRIES + 1))
-        # Disarm only when a fresh measurement actually landed AND the best
-        # spmm did not change: an ell-branch repro runs the full default
-        # sweep, which can crown a NEW winner with only one fresh run —
-        # that new best then needs its own reproduction pass.
         if [ "$FRESH" -eq 1 ]; then
           NEWBEST=$(best_spmm)
           if [ -z "$NEWBEST" ] || [ "$NEWBEST" = "$BEST" ]; then
@@ -184,40 +172,33 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "$DONE_N" > "$CURSOR"
     continue
   fi
-  wait_alive
   echo "run[$i]: $LINE" >> "$STATUS"
   # shellcheck disable=SC2086
   timeout "$(bench_timeout_for "$LINE")" python bench.py $LINE \
-    > "$LOGDIR/r5w5_${STAMP}_q$i.log" 2>&1
+    > "$LOGDIR/w5_${STAMP}_q$i.log" 2>&1
   rc=$?
-  FRESH=$(fresh_ok "$LOGDIR/r5w5_${STAMP}_q$i.log" && echo 1 || echo 0)
+  FRESH=$(fresh_ok "$LOGDIR/w5_${STAMP}_q$i.log" && echo 1 || echo 0)
   echo "run[$i] rc=$rc fresh=$FRESH" >> "$STATUS"
-  if [ "$FRESH" -eq 1 ]; then
+  if handle_rc "$rc" "$LINE"; then
+    :   # resilience exit code: the requeue policy above already acted
+  elif [ "$FRESH" -eq 1 ]; then
     RAN_ANY=1
     REPRO_DONE=0   # new measurements may change best_known; re-arm the repro
     REPRO_TRIES=0
-    if partial_run "$LOGDIR/r5w5_${STAMP}_q$i.log" \
-       && [ "$RETRY_BUDGET" -gt 0 ]; then
+    if partial_run "$LOGDIR/w5_${STAMP}_q$i.log"; then
       # partial = measured-then-died: the rest of the line's candidates
       # still deserve their window
-      RETRY_BUDGET=$((RETRY_BUDGET - 1))
-      ensure_queue_newline
-      printf '%s\n' "$LINE" >> "$QUEUE"
-      echo "run[$i] partial; requeued (retry budget $RETRY_BUDGET)" >> "$STATUS"
+      requeue_line "$LINE" "partial measurement"
     fi
-  elif [ "$RETRY_BUDGET" -gt 0 ]; then
-    # no fresh measurement (tunnel died mid-run, or a compile crash the
-    # preflight could not see): give the line another shot at the back of
-    # the queue rather than silently losing its candidates for the session
-    RETRY_BUDGET=$((RETRY_BUDGET - 1))
-    ensure_queue_newline
-    printf '%s\n' "$LINE" >> "$QUEUE"
-    echo "run[$i] requeued (retry budget $RETRY_BUDGET)" >> "$STATUS"
+  else
+    # no fresh measurement and no resilience exit code (e.g. a compile
+    # crash the preflight could not see): one more shot at the back of the
+    # queue rather than silently losing the candidates for the session
+    requeue_line "$LINE" "no fresh measurement (rc=$rc)"
   fi
-  # Persist the cursor only AFTER the fresh/requeue decision: a
-  # kill-and-relaunch mid-run used to advance past the in-flight line and
-  # silently drop it; now the relaunch replays it instead (bench runs are
-  # idempotent — best_known only improves).
+  # Persist the cursor only AFTER the requeue decision: a kill-and-relaunch
+  # mid-run replays the in-flight line instead of silently dropping it
+  # (bench runs are idempotent — best_known only improves).
   echo "$DONE_N" > "$CURSOR"
   i=$((i + 1))
 done
