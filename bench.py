@@ -202,10 +202,18 @@ def _vrep(v):
     return v[7] if len(v) > 7 else 1
 
 
+def _vfeat(v):
+    """Feat-axis size of a variant tuple (9th field: parallel/feat.py's
+    tensor axis — hidden dimensions sharded T-ways, H/T halo payloads, one
+    feat psum per layer); shorter tuples mean 1 — pre-existing names and
+    queue lines stay valid."""
+    return v[8] if len(v) > 8 else 1
+
+
 def _vname(v):
     """Candidate display/CLI name for a (spmm, use_pallas, gather_dtype,
-    dense_dtype, tile[, halo[, overlap[, replicas]]]) variant tuple — the
-    vocabulary --candidates and .watch_queue lines are written in
+    dense_dtype, tile[, halo[, overlap[, replicas[, feat]]]]) variant tuple
+    — the vocabulary --candidates and .watch_queue lines are written in
     (unit-pinned so a rename can never silently invalidate a queued
     tunnel-window run)."""
     return (v[0] + ("+pallas" if v[1] else "")
@@ -214,7 +222,8 @@ def _vname(v):
             + (f"+t{v[4]}" if v[4] != 512 else "")
             + ({"ragged": "+rag", "shift": "+shift"}.get(_vhalo(v), ""))
             + ("+ovl" if _vovl(v) == "split" else "")
-            + (f"+rep{_vrep(v)}" if _vrep(v) != 1 else ""))
+            + (f"+rep{_vrep(v)}" if _vrep(v) != 1 else "")
+            + (f"+feat{_vfeat(v)}" if _vfeat(v) != 1 else ""))
 
 
 def _emit_result_line(args, value, status=None, measured_at=None, spmm=None,
@@ -491,7 +500,11 @@ def main():
                          "independently-BNS-sampled replicas, fused "
                          "cross-replica gradient mean, needs N devices: "
                          "hybrid+rep2, ell+rep2, hybrid+pallas+rep2, "
-                         "hybrid+pallas+rag+ovl+rep2)"
+                         "hybrid+pallas+rag+ovl+rep2; a +featT suffix "
+                         "shards hidden dims T-ways on the innermost feat "
+                         "axis — H/T halo payloads, one psum per layer, "
+                         "needs T devices: hybrid+feat2, ell+feat2, "
+                         "hybrid+pallas+feat2, hybrid+pallas+rag+ovl+feat2)"
                          " — for short TPU-tunnel windows. The pallas names "
                          "only exist on a TPU backend without --no-pallas; "
                          "an all-unknown list is an error (exit 2), never a "
@@ -518,23 +531,31 @@ def main():
         # axon tunnel is WEDGED, the sitecustomize hangs at interpreter
         # start, before this line: launch with PALLAS_AXON_POOL_IPS= then.)
         os.environ["JAX_PLATFORMS"] = "cpu"
-    # +repN candidates need N x 1 devices. The flag below only ever affects
-    # the host (CPU) platform — free virtual devices for smoke/preflight runs
-    # — and must be set BEFORE jax initializes; a TPU backend ignores it, and
-    # a 1-chip TPU window simply fails the repN candidate into the fallback
-    # path (logged), never the whole run. A full (no --candidates) run uses
-    # UNIVERSE_MAX_REP: keep it == the largest replica field in the
-    # `universe` tuples below (it cannot be derived from the list here —
-    # the list is built after `import jax`, and this flag must precede it).
-    UNIVERSE_MAX_REP = 2
+    # +repN / +featT candidates need N x T devices (the bench mesh is
+    # (replicas, 1 part, feat)). The flag below only ever affects the host
+    # (CPU) platform — free virtual devices for smoke/preflight runs — and
+    # must be set BEFORE jax initializes; a TPU backend ignores it, and a
+    # 1-chip TPU window simply fails the repN/featT candidate into the
+    # fallback path (logged), never the whole run. A full (no --candidates)
+    # run uses UNIVERSE_MAX_DEVICES: keep it == the largest replicas*feat
+    # product in the `universe` tuples below (it cannot be derived from the
+    # list here — the list is built after `import jax`, and this flag must
+    # precede it).
+    UNIVERSE_MAX_DEVICES = 2
     import re as _re
-    _reps = [int(m) for m in _re.findall(r"\+rep(\d+)", args.candidates)]
-    _max_rep = max(_reps, default=UNIVERSE_MAX_REP if not args.candidates else 1)
-    if _max_rep > 1 and "--xla_force_host_platform_device_count" not in \
+    _needs = []
+    for _nm in args.candidates.split(","):
+        _r = _re.search(r"\+rep(\d+)", _nm)
+        _f = _re.search(r"\+feat(\d+)", _nm)
+        _needs.append((int(_r.group(1)) if _r else 1)
+                      * (int(_f.group(1)) if _f else 1))
+    _max_dev = (max(_needs) if args.candidates
+                else UNIVERSE_MAX_DEVICES)
+    if _max_dev > 1 and "--xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={_max_rep}").strip()
+            + f" --xla_force_host_platform_device_count={_max_dev}").strip()
     import jax
 
     if args.prep_only or os.environ.get("JAX_PLATFORMS"):
@@ -624,7 +645,16 @@ def main():
                      ("hybrid", True, "native", "native", 512, "padded",
                       "off", 2),
                      ("hybrid", True, "native", "native", 512, "ragged",
-                      "split", 2)]
+                      "split", 2),
+                     # feat/tensor axis (parallel/feat.py): hidden dims
+                     # sharded 2-ways on a (1, 1, 2) mesh — measures the
+                     # per-layer feat-psum + sliced-SpMM recipe on 2 chips
+                     # (the T x halo-byte win itself needs a multi-part pod);
+                     # wide-hidden (--hidden 512) is where it should win
+                     ("hybrid", True, "native", "native", 512, "padded",
+                      "off", 1, 2),
+                     ("hybrid", True, "native", "native", 512, "ragged",
+                      "split", 1, 2)]
     universe += [("hybrid", False, "native", "native", 512),
                  ("hybrid", False, "native", "native", 256),
                  ("hybrid", False, "native", "int8", 512),
@@ -642,7 +672,11 @@ def main():
                  ("ell", False, "native", "native", 512, "padded", "split"),
                  ("hybrid", False, "native", "native", 512, "padded",
                   "off", 2),
-                 ("ell", False, "native", "native", 512, "padded", "off", 2)]
+                 ("ell", False, "native", "native", 512, "padded", "off", 2),
+                 ("hybrid", False, "native", "native", 512, "padded",
+                  "off", 1, 2),
+                 ("ell", False, "native", "native", 512, "padded",
+                  "off", 1, 2)]
     anchor = ("ell", False, "native", "native", 512)
     if args.spmm == "hybrid":
         candidates = [anchor] + universe
@@ -711,6 +745,7 @@ def main():
                       halo_exchange=_vhalo(variant),
                       overlap=_vovl(variant),
                       replicas=_vrep(variant),
+                      feat=_vfeat(variant),
                       heads=2 if args.model == "gat" else 1,
                       n_layers=args.layers,
                       n_hidden=args.hidden, use_pp=True, dropout=0.5,
@@ -729,9 +764,9 @@ def main():
         t0 = time.time()
         spmm = variant[0]
         cfg = make_cfg(variant)
-        # +repN candidates compile onto their own (N, 1) replica mesh; the
+        # +repN/+featT candidates compile onto their own (N, 1, T) mesh; the
         # layout cache is mesh-independent so the stacks are still shared
-        mesh = make_mesh(1, _vrep(variant))
+        mesh = make_mesh(1, _vrep(variant), _vfeat(variant))
         fns, hspec, tables, tables_full = build_step_fns(
             cfg, spec, art, mesh, layout_cache=layout_cache)
         if spmm == "hybrid":
@@ -759,7 +794,13 @@ def main():
         else:
             blk["feat"] = pp_out
         params, state = init_params(jax.random.key(0), spec, dtype=dtype)
-        params = place_replicated(params, mesh)
+        if _vfeat(variant) > 1:
+            # feat-sharded weights (parallel/feat.py regex rules); the init
+            # itself is the same host tree, so losses stay gate-comparable
+            from bnsgcn_tpu.parallel import feat as feat_mod
+            params = feat_mod.place_params(params, mesh, spec)
+        else:
+            params = place_replicated(params, mesh)
         state = place_replicated(state, mesh)
         _, _, opt = init_training(cfg, spec, mesh)
         log("compiling + warmup...")
@@ -909,7 +950,11 @@ def main():
                 persist_layouts()     # keep layouts even if compile failed
             l0 = float(built[6])      # first-step (forward-dominated) loss
             quantized = variant[2] != "native" or variant[3] == "int8"
-            multi_rep = _vrep(variant) > 1
+            # multi-device variants (+repN replica mean, +featT psum-order
+            # drift) are gated wider and must never become native twins —
+            # 'base' strips their suffixes, so without this exclusion a
+            # feat2 run's loss would silently gate its quantized siblings
+            multi_dev = _vrep(variant) > 1 or _vfeat(variant) > 1
             base = variant[0] + ("+pallas" if variant[1] else "")
             # quantized variants gate against their NATIVE TWIN (same SpMM
             # base, native gathers/tiles) at 5%: the twin isolates exactly
@@ -918,10 +963,11 @@ def main():
             # slightly widened for the ell-vs-hybrid tiling difference.
             # +repN losses are the MEAN over N independent BNS/dropout draws
             # — a different (lower-variance, but differently-seeded) sample
-            # of the same estimator — so they get the widened gate too.
+            # of the same estimator — so they get the widened gate too
+            # (+featT only reorders float sums, but shares the exclusion).
             if quantized and base in native_l0:
                 gate0, tol0, gsrc = native_l0[base], 0.05, f"native {base}"
-            elif quantized or multi_rep:
+            elif quantized or multi_dev:
                 gate0, tol0, gsrc = ref_loss, 0.07, "ell anchor"
             else:
                 gate0, tol0, gsrc = ref_loss, 0.02, "ell anchor"
@@ -945,7 +991,7 @@ def main():
         # diverges the trajectory); same twin-first gating as step 0
         if quantized and base in native_lf:
             gate_f, tol, gsrc = native_lf[base], 0.05, f"native {base}"
-        elif quantized or multi_rep:
+        elif quantized or multi_dev:
             gate_f, tol, gsrc = ref_final, 0.07, "ell anchor"
         else:
             gate_f, tol, gsrc = ref_final, 0.02, "ell anchor"
@@ -953,7 +999,7 @@ def main():
             log(f"  spmm={name} final loss {lf:.4f} != {gsrc} "
                 f"{gate_f:.4f} (tol {tol:.0%}); DISCARDED")
             continue
-        if not quantized and not multi_rep:
+        if not quantized and not multi_dev:
             # record the twin reference only for a native run that passed
             # BOTH gates — a diverged native run must never become the
             # gate its quantized twins are judged against

@@ -15,6 +15,13 @@ from dataclasses import dataclass
 from typing import Optional
 
 
+class ConfigError(ValueError):
+    """A named configuration error: main.py prints it and exits 2 (the
+    deterministic-argument-error code the bench supervisor and requeue
+    wrappers never relaunch), instead of a stack trace from deep inside
+    mesh construction."""
+
+
 @dataclass(frozen=True)
 class Config:
     # --- data / partitioning (reference helper/parser.py:6-13,37-41) ---
@@ -74,6 +81,15 @@ class Config:
                                         # Needs replicas*n_partitions devices;
                                         # 1 = the historical 1-D parts mesh,
                                         # bit-identical
+    feat: int = 1                       # feat-axis size of the 3-D
+                                        # ('replicas','parts','feat') mesh:
+                                        # shard hidden dimensions T-ways —
+                                        # perfectly load-balanced (no boundary
+                                        # nodes on this axis), halo wire bytes
+                                        # drop ~T x, weight/optimizer HBM and
+                                        # matmul FLOPs /T; one feat psum per
+                                        # layer. Needs replicas*parts*feat
+                                        # devices; 1 = no axis, bit-identical
     dtype: str = "float32"              # compute dtype: 'float32' | 'bfloat16'
     edge_chunk: int = 0                 # >0: aggregate edges in chunks of this size (bounds HBM)
     spmm: str = "ell"                   # 'ell' (scatter-free bucketed) | 'hybrid'
@@ -239,6 +255,12 @@ def create_parser() -> argparse.ArgumentParser:
                         "graph replicas on a ('replicas','parts') mesh and "
                         "average gradients (needs N*n_partitions devices; "
                         "use when devices > partitions)")
+    p.add_argument("--feat", type=int, default=1,
+                   help="feat/tensor-axis size: shard hidden dimensions "
+                        "T-ways on the innermost mesh axis (zero boundary "
+                        "nodes on this axis; halo wire bytes and matmul "
+                        "FLOPs drop ~T x; one psum per layer) — wins on "
+                        "wide-hidden runs; needs replicas*parts*feat devices")
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--spmm", type=str, default="ell",
                    choices=["ell", "hybrid", "auto", "segment"])

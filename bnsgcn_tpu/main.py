@@ -18,7 +18,7 @@ import sys
 import time
 
 from bnsgcn_tpu import resilience
-from bnsgcn_tpu.config import Config, parse_config
+from bnsgcn_tpu.config import Config, ConfigError, parse_config
 from bnsgcn_tpu.parallel import coord
 from bnsgcn_tpu.run import prepare_partition, run_training
 
@@ -75,6 +75,13 @@ def main(argv=None):
     # the hung-step watchdog exits 77 from inside resilience.py itself.
     try:
         res = run_training(cfg)
+    except ConfigError as ex:
+        # a named configuration error (e.g. replicas x parts x feat exceeds
+        # the device budget): deterministic argument problem — exit 2 like
+        # argparse, so requeue wrappers and the bench supervisor never
+        # relaunch it
+        print(f"[config] {ex}", file=sys.stderr)
+        sys.exit(2)
     except resilience.PreemptedError as ex:
         print(f"[resilience] {ex}")
         sys.stdout.flush()

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from bnsgcn_tpu.ops.spmm import agg_sum, segment_softmax
 from bnsgcn_tpu.config import Config
+from bnsgcn_tpu.parallel.feat import feat_shardable
 
 
 @dataclass(frozen=True)
@@ -112,6 +113,13 @@ class GraphEnv:
     # the layer body as start-exchange -> interior-agg -> finish-exchange ->
     # frontier-agg -> merge through this seam). None = the historical
     # exchange-then-aggregate path.
+    feat_axis: Optional[str] = None    # 3-D ('replicas','parts','feat') mesh
+    n_feat_shards: int = 1             # (parallel/feat.py): shardable layers
+                                       # run exchange+SpMM on an H/T column
+                                       # slice and psum the weight-shard
+                                       # partials over 'feat' (one collective
+                                       # per layer). None/1 = the historical
+                                       # full-width bodies, bit-identical.
 
 
 def env_agg_sum(env: "GraphEnv", h_ext: jax.Array) -> jax.Array:
@@ -215,6 +223,67 @@ def _dropout(h, rate, rng, training):
     return jnp.where(mask, h / keep, 0.0).astype(h.dtype)
 
 
+def _dropout_heads(a, rate, rng, training, n_total, off):
+    """Last-dim (head) dropout whose mask is drawn at the FULL width
+    `n_total` and sliced at `off` — a feat-sharded GAT layer therefore
+    reproduces exactly the feat=1 run's per-head masks (the exactness tests
+    compare feat=T against feat=1 with dropout on). off=None with
+    n_total == a.shape[-1] is bit-identical to `_dropout`."""
+    if not training or rate <= 0.0 or rng is None:
+        return a
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, a.shape[:-1] + (n_total,))
+    if off is not None:
+        mask = jax.lax.dynamic_slice_in_dim(mask, off, a.shape[-1], a.ndim - 1)
+    return jnp.where(mask, a / keep, 0.0).astype(a.dtype)
+
+
+# ----------------------------------------------------------------------------
+# feat-axis (tensor-parallel) layer body — parallel/feat.py's contract:
+# slice the input activations to this shard's H/T columns, run the (sliced)
+# exchange + SpMM and the local weight-row-shard matmul, then ONE psum over
+# 'feat' where the layer transitions shards. Dropout always fires on the
+# FULL pre-slice activations (identical masks to feat=1); biases are
+# replicated and added once, after the psum.
+# ----------------------------------------------------------------------------
+
+def _feat_slice(env: "GraphEnv", h: jax.Array) -> jax.Array:
+    """This feat shard's column slice h[:, f*k:(f+1)*k], k = width/T."""
+    k = h.shape[-1] // env.n_feat_shards
+    f = jax.lax.axis_index(env.feat_axis)
+    return jax.lax.dynamic_slice_in_dim(h, f * k, k, h.ndim - 1)
+
+
+def _feat_psum(env: "GraphEnv", x: jax.Array) -> jax.Array:
+    return jax.lax.psum(x, env.feat_axis)
+
+
+def _feat_layer(p, i, h, env: "GraphEnv", spec: "ModelSpec") -> jax.Array:
+    """One feat-sharded GCN / GraphSAGE / dense layer (h arrives full-width,
+    already dropped out; returns the full-width psummed output). The halo
+    exchange inside rides the H/T slice — its wire bytes drop T x."""
+    is_graph = i < spec.n_graph_layers
+    if not is_graph or (env.training and spec.use_pp and i == 0):
+        # pure dense matmul: the linear tail and the precomputed layer 0
+        part = _feat_slice(env, h) @ p["w"]
+        return _feat_psum(env, part) + p["b"]
+    if spec.model == "gcn":
+        s = env_agg_exchange(env, i, _feat_slice(env, h), scale_out_norm=True)
+        part = (s / env.in_norm[:, None]).astype(h.dtype) @ p["w"]
+        return _feat_psum(env, part) + p["b"]
+    if (not env.training) and spec.use_pp and i == 0:
+        # eval pp layer 0: cat(feat, mean) @ W — the concat consumes the
+        # full-width mean, so only the linear shards (full-rate eval runs
+        # once per log_every; the training exchange is what the axis thins)
+        ah = env_agg_exchange(env, i, h) / env.in_norm[:, None]
+        part = _feat_slice(env, jnp.concatenate([h[:env.n_dst], ah], 1)) @ p["w"]
+        return _feat_psum(env, part) + p["b"]
+    hs = _feat_slice(env, h)
+    ah = (env_agg_exchange(env, i, hs) / env.in_norm[:, None]).astype(h.dtype)
+    part = hs[:env.n_dst] @ p["linear1"]["w"] + ah @ p["linear2"]["w"]
+    return _feat_psum(env, part) + p["linear1"]["b"] + p["linear2"]["b"]
+
+
 def _layer_norm(p, h, eps=1e-5):
     # stats in f32 (bf16 activations would lose the variance), output in h.dtype
     hf = h.astype(jnp.float32)
@@ -238,12 +307,20 @@ def _sync_batch_norm(p, st, h, env: GraphEnv, whole_size, momentum=0.1, eps=1e-5
             # replica-axis meshes fold the cross-replica moment mean into
             # the same psum (one collective over both axes; whole_size
             # scales by n_replicas below because each replica holds the
-            # full graph, not a shard of it)
-            axes = (env.axis_name if env.replica_axis is None
-                    else (env.replica_axis, env.axis_name))
+            # full graph, not a shard of it). The feat axis rides the same
+            # psum the same way: its moments are identical per shard
+            # (computed on the full post-psum activations), so summing
+            # them and scaling whole_size by n_feat_shards keeps the value
+            # exact with still ONE collective.
+            if env.replica_axis is None and env.feat_axis is None:
+                axes = env.axis_name
+            else:
+                axes = tuple(a for a in (env.replica_axis, env.axis_name,
+                                         env.feat_axis) if a is not None)
             sum_x = jax.lax.psum(sum_x, axes)
             sum_x2 = jax.lax.psum(sum_x2, axes)
-        whole_size = whole_size * max(env.n_replicas, 1)
+        whole_size = (whole_size * max(env.n_replicas, 1)
+                      * max(env.n_feat_shards, 1))
         mean = sum_x / whole_size
         # the reference's estimator (module/sync_bn.py:19-20) sums over ALL
         # local rows but divides by whole_size = n_train; when n_train < the
@@ -283,13 +360,21 @@ def _sage_layer(p, i, h, env: GraphEnv):
 
 
 def _gat_layer(p, h_dst, h_ext, presence, env: GraphEnv, heads, out_feats,
-               rng, dropout, training, negative_slope=0.2):
+               rng, dropout, training, negative_slope=0.2,
+               total_heads=None, head_off=None):
     """DGL-GATConv equivalent over the extended (inner+halo) node space.
 
     `presence` masks softmax contributions of halo slots that were not sampled
     this epoch (and of padded edges) — reference semantics where unsampled
     halos simply don't appear in the constructed graph (train.py:256-281).
+
+    Feat-sharded GAT (parallel/feat.py): `heads` is this shard's local head
+    count, `p` its head-sliced params; `total_heads`/`head_off` make the
+    attention-dropout masks the exact head slice of the feat=1 masks
+    (defaults keep the historical full-head behavior bit-identical).
     """
+    if total_heads is None:
+        total_heads = heads
     r1 = r2 = r3 = None
     if training and rng is not None:
         r1, r2, r3 = jax.random.split(rng, 3)
@@ -310,7 +395,8 @@ def _gat_layer(p, h_dst, h_ext, presence, env: GraphEnv, heads, out_feats,
         from bnsgcn_tpu.ops.ell_attention import gat_ell_attention
         spec_e, arrays_e = env.gat_ell
         out = gat_ell_attention(spec_e, arrays_e, z, el, er, presence,
-                                r3, dropout, training, negative_slope)
+                                r3, head_off, dropout, training,
+                                negative_slope)
         return out + p["bias"].reshape(1, heads, out_feats)
     er_pad = jnp.concatenate([er, jnp.zeros((1, heads), er.dtype)], 0)
     e = el[env.src] + er_pad[jnp.minimum(env.dst, env.n_dst)]
@@ -319,7 +405,8 @@ def _gat_layer(p, h_dst, h_ext, presence, env: GraphEnv, heads, out_feats,
     if presence is not None:
         edge_mask = presence[env.src]
     alpha = segment_softmax(e, env.dst, env.n_dst, mask=edge_mask)
-    alpha = _dropout(alpha, dropout, r3, training)        # attn_drop
+    alpha = _dropout_heads(alpha, dropout, r3, training,  # attn_drop
+                           total_heads, head_off)
     msg = z[env.src] * alpha[:, :, None]                  # [E, heads, out]
     out = jax.ops.segment_sum(msg.reshape(msg.shape[0], heads * out_feats),
                               env.dst, num_segments=env.n_dst + 1)[:env.n_dst]
@@ -366,11 +453,20 @@ def _layer_forward(h, *, i, params, state, spec: ModelSpec, env: GraphEnv, rng):
     name = f"layer_{i}"
     p = params[name]
     is_graph_layer = i < spec.n_graph_layers
+    # feat-axis tensor parallelism (parallel/feat.py): layers whose width
+    # tiles the axis run the sharded body; the rest keep the historical one
+    # (their params matched the replicated catch-all rule)
+    fshard = (env.feat_axis is not None
+              and feat_shardable(spec, i, env.n_feat_shards))
 
     if spec.model in ("gcn", "graphsage"):
-        # dropout -> (exchange) -> layer   (module/model.py:44-51,79-86)
+        # dropout -> (exchange) -> layer   (module/model.py:44-51,79-86);
+        # dropout fires on the FULL width even when the layer shards — the
+        # feat=T masks are exactly the feat=1 masks
         h = _dropout(h, spec.dropout, rng, env.training)
-        if not is_graph_layer:
+        if fshard:
+            h = _feat_layer(p, i, h, env, spec)
+        elif not is_graph_layer:
             h = _linear(p, h)
         elif env.training and spec.use_pp and i == 0:
             # precomputed layer 0: pure dense matmul (module/layer.py:29-30,83-84)
@@ -386,6 +482,13 @@ def _layer_forward(h, *, i, params, state, spec: ModelSpec, env: GraphEnv, rng):
     elif spec.model == "gat":
         out_feats = spec.layer_sizes[i + 1]
         if is_graph_layer:
+            # feat-sharded GAT: each shard owns heads/T heads (params are
+            # head-sliced by the partition rules); the exchange stays
+            # full-width and the head mean becomes local-sum -> one psum
+            heads_l = (spec.heads // env.n_feat_shards if fshard
+                       else spec.heads)
+            head_off = (jax.lax.axis_index(env.feat_axis) * heads_l
+                        if fshard else None)
             if env.training:
                 if i == 0 and spec.use_pp:
                     assert env.gat_feat0 is not None
@@ -399,9 +502,17 @@ def _layer_forward(h, *, i, params, state, spec: ModelSpec, env: GraphEnv, rng):
                 # full-rate halo exchange under mesh-distributed eval
                 h_ext, presence = env.exchange(i, h)
                 h_d = h
-            h = _gat_layer(p, h_d, h_ext, presence, env, spec.heads, out_feats,
-                           rng, spec.dropout, env.training)
-            h = h.mean(1)                             # mean over heads (module/model.py:124)
+            h = _gat_layer(p, h_d, h_ext, presence, env, heads_l, out_feats,
+                           rng, spec.dropout, env.training,
+                           total_heads=spec.heads, head_off=head_off)
+            if fshard:
+                # mean over ALL heads = psum of local head sums / H
+                h = _feat_psum(env, h.sum(1)) / spec.heads
+            else:
+                h = h.mean(1)          # mean over heads (module/model.py:124)
+        elif fshard:
+            h = _dropout(h, spec.dropout, rng, env.training)
+            h = _feat_layer(p, i, h, env, spec)
         else:
             h = _dropout(h, spec.dropout, rng, env.training)
             h = _linear(p, h)

@@ -186,12 +186,22 @@ def _seeds_of(attn_rng, training, drop):
     return jax.random.key_data(attn_rng).astype(jnp.uint32).reshape(-1)[:2]
 
 
+def _head_idx(heads, head_off):
+    """Global head ids of this call's head block. `head_off` (feat-sharded
+    GAT, parallel/feat.py) offsets the dropout hash so shard f's masks are
+    exactly heads [off, off+heads) of the feat=1 masks; None = heads 0..H."""
+    hidx = jnp.arange(heads, dtype=jnp.uint32)
+    if head_off is not None:
+        hidx = hidx + jnp.asarray(head_off).astype(jnp.uint32)
+    return hidx
+
+
 def _fwd_buckets(spec, arrays, zp, elp, erp, pres, drop, training,
-                 slope, seeds, chunk_gathers=2_000_000):
+                 slope, seeds, head_off=None, chunk_gathers=2_000_000):
     """Forward over the dst-major layout. Returns per-bucket weighted sums
     and per-bucket softmax stats (m', denom), all in table-row order."""
     heads = zp.shape[1]
-    hidx = jnp.arange(heads, dtype=jnp.uint32)
+    hidx = _head_idx(heads, head_off)
     outs, ms, ds = [], [], []
     offset = 0
     for k, w in enumerate(spec.widths):
@@ -228,7 +238,7 @@ def _fwd_buckets(spec, arrays, zp, elp, erp, pres, drop, training,
     return outs, ms, ds
 
 
-def _gat_fwd_impl(spec, arrays, z, el, er, presence, attn_rng,
+def _gat_fwd_impl(spec, arrays, z, el, er, presence, attn_rng, head_off,
                   attn_dropout, training, negative_slope):
     heads, fdim = z.shape[1], z.shape[2]
     zp = _pad_rows(z)
@@ -237,7 +247,8 @@ def _gat_fwd_impl(spec, arrays, z, el, er, presence, attn_rng,
     pres = _pad_rows(presence, False) if presence is not None else None
     seeds = _seeds_of(attn_rng, training, attn_dropout)
     outs, ms, ds = _fwd_buckets(spec, arrays, zp, elp, erp, pres,
-                                attn_dropout, training, negative_slope, seeds)
+                                attn_dropout, training, negative_slope, seeds,
+                                head_off=head_off)
     zero = jnp.zeros((1, heads, fdim), z.dtype)
     out = jnp.concatenate(outs + [zero], axis=0)[arrays["gat_perm"]]
     # per-dst stats for the transposed backward (degree-0 rows hit the
@@ -247,10 +258,10 @@ def _gat_fwd_impl(spec, arrays, z, el, er, presence, attn_rng,
     return out, (m_tab[arrays["gat_perm"]], d_tab[arrays["gat_perm"]], seeds)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 7, 8, 9))
+@partial(jax.custom_vjp, nondiff_argnums=(0, 8, 9, 10))
 def gat_ell_attention(spec: GatEllSpec, arrays: dict, z: jax.Array,
                       el: jax.Array, er: jax.Array,
-                      presence, attn_rng,
+                      presence, attn_rng, head_off,
                       attn_dropout: float, training: bool,
                       negative_slope: float = 0.2) -> jax.Array:
     """out[v] = sum_u softmax_u(leaky(el[u] + er[v])) * z[u] over v's ELL row.
@@ -258,25 +269,26 @@ def gat_ell_attention(spec: GatEllSpec, arrays: dict, z: jax.Array,
     z: [n_ext, heads, F'], el: [n_ext, heads], er: [n_dst, heads].
     Returns [n_dst, heads, F']. Padded slots and absent (unsampled) halos are
     masked out of the softmax (the reference's sampled-subgraph semantics,
-    train.py:256-281).
+    train.py:256-281). `head_off` (None = 0) shifts the dropout hash's head
+    ids for feat-sharded head blocks (parallel/feat.py).
     """
     out, _ = _gat_fwd_impl(spec, arrays, z, el, er, presence, attn_rng,
-                           attn_dropout, training, negative_slope)
+                           head_off, attn_dropout, training, negative_slope)
     return out
 
 
-def _gat_fwd_rule(spec, arrays, z, el, er, presence, attn_rng,
+def _gat_fwd_rule(spec, arrays, z, el, er, presence, attn_rng, head_off,
                   attn_dropout, training, negative_slope):
     out, (m_v, denom_v, seeds) = _gat_fwd_impl(
-        spec, arrays, z, el, er, presence, attn_rng, attn_dropout, training,
-        negative_slope)
-    return out, (arrays, z, el, er, presence, m_v, denom_v, seeds)
+        spec, arrays, z, el, er, presence, attn_rng, head_off, attn_dropout,
+        training, negative_slope)
+    return out, (arrays, z, el, er, presence, head_off, m_v, denom_v, seeds)
 
 
 def _gat_bwd_rule(spec, attn_dropout, training, negative_slope, res, g):
-    arrays, z, el, er, presence, m_v, denom_v, seeds = res
+    arrays, z, el, er, presence, head_off, m_v, denom_v, seeds = res
     heads = z.shape[1]
-    hidx = jnp.arange(heads, dtype=jnp.uint32)
+    hidx = _head_idx(heads, head_off)
     drop = attn_dropout if training else 0.0
     keep_p = 1.0 - drop
 
@@ -375,7 +387,7 @@ def _gat_bwd_rule(spec, attn_dropout, training, negative_slope, res, g):
     d_z = ell_combine(bspec, dz_outs, arrays["gat_bwd_perm"], cp, cs)
     d_el = ell_combine(bspec, del_outs, arrays["gat_bwd_perm"], cp, cs)
     return (None, d_z.astype(z.dtype), d_el.astype(el.dtype),
-            d_er.astype(er.dtype), None, None)
+            d_er.astype(er.dtype), None, None, None)
 
 
 gat_ell_attention.defvjp(_gat_fwd_rule, _gat_bwd_rule)

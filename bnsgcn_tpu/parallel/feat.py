@@ -1,0 +1,233 @@
+"""Feature/tensor axis of the 3-D ('replicas', 'parts', 'feat') mesh.
+
+Partition parallelism alone is hostage to METIS skew (the slowest part sets
+the epoch time) and its halo volume grows with P. Sharding the HIDDEN
+dimension instead (NeutronTP, PAPERS.md) is perfectly load-balanced — every
+device holds N x (H/T) activations and there are *no boundary nodes at all*
+on that axis; Plexus shows the 3-D composition of data/partition/tensor axes
+is what reaches billion-edge scale. This module owns the 'feat' axis:
+
+  * the axis sits INNERMOST on the mesh (parallel/replicas.make_mesh):
+    tensor traffic is per-layer and latency-sensitive, so it gets the
+    fastest ICI hop; replicas stay outermost/DCN-friendly and the halo
+    exchange keeps the middle 'parts' hop;
+  * layer weights are SHARDED over 'feat' by regex-driven PartitionSpec
+    rules (`gnn_partition_rules` + `match_partition_rules`, the fmengine
+    pattern): GCN/SAGE weight matrices along their input-feature (row) dim,
+    GAT along the head dim; biases and norm params stay replicated;
+  * each layer computes its SpMM/attention on an H/T activation slice and
+    psums the per-shard partials over 'feat' exactly where the layer
+    transitions shards — ONE collective per layer (models/gnn._feat_layer),
+    scoped to 'feat' the same way halo collectives stay scoped to 'parts';
+  * the halo exchange therefore carries H/T-width payloads: halo wire bytes
+    drop ~T x for free, multiplicative with BNS sampling, the ragged wire
+    and --overlap split;
+  * the BNS sampling keys never fold the feat index — all feat shards of a
+    (replica, part) carry column slices of the SAME activations and must
+    draw the SAME boundary sample (unlike the replica axis, which exists to
+    draw independent ones).
+
+`--feat 1` constructs no axis at all (make_mesh delegates to the 2-D/1-D
+constructors), so every pre-existing compiled program is unchanged by
+construction — pinned bitwise by tests/test_feat.py.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+FEAT_AXIS = "feat"
+
+
+def n_feat(mesh: Mesh) -> int:
+    """Feat-axis size of a mesh; 1 for the 2-D/1-D meshes."""
+    return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get(FEAT_AXIS, 1))
+
+
+def feat_axis(mesh: Mesh):
+    """'feat' when the mesh carries the axis, else None — what GraphEnv and
+    grad_reduce_axes consume (None = the historical paths, bit-identical)."""
+    return FEAT_AXIS if FEAT_AXIS in mesh.axis_names else None
+
+
+# ----------------------------------------------------------------------------
+# per-layer shardability — the ONE source of truth shared by the parameter
+# rules below and the layer bodies in models/gnn.py (they must agree, or a
+# sharded weight would meet an unsharded activation slice)
+# ----------------------------------------------------------------------------
+
+def layer_fin(spec, i: int) -> int:
+    """Effective contraction (input) width of layer i — what the feat axis
+    slices. GraphSAGE's precomputed layer 0 consumes the [feat, mean_nbr]
+    concat, doubling it (module/layer.py:59)."""
+    fin = spec.layer_sizes[i]
+    if (spec.model == "graphsage" and spec.use_pp and i == 0
+            and i < spec.n_graph_layers):
+        fin *= 2
+    return fin
+
+
+def shardable_layers(spec, T: int) -> tuple[bool, ...]:
+    """Which layers can shard over a feat axis of size T.
+
+    GCN/SAGE (and every dense tail layer): the input width must divide T —
+    the activation slice and the weight's row shard must tile exactly.
+    GAT graph layers shard HEADS (the attention math is per-head
+    independent; the halo exchange stays full-width there — GAT wins come
+    from the per-head softmax/combine, not wire bytes): heads % T == 0.
+    A non-shardable layer simply runs the historical full-width body with
+    its weight replicated — mixed stacks are fine (e.g. a raw 602-wide
+    layer 0 under --feat 4 stays full while every hidden layer shards)."""
+    if T <= 1:
+        return (False,) * spec.n_layers
+    out = []
+    for i in range(spec.n_layers):
+        if spec.model == "gat" and i < spec.n_graph_layers:
+            out.append(spec.heads % T == 0)
+        else:
+            out.append(layer_fin(spec, i) % T == 0)
+    return tuple(out)
+
+
+def feat_shardable(spec, i: int, T: int) -> bool:
+    return shardable_layers(spec, T)[i]
+
+
+def shard_width(width: int, T: int, shardable: bool = True) -> int:
+    """Wire/activation width of one feat shard: width/T when the owning
+    layer shards, else the full width (reporting + microbench helper)."""
+    return width // T if (shardable and T > 1 and width % T == 0) else width
+
+
+# ----------------------------------------------------------------------------
+# regex-driven parameter PartitionSpecs (the fmengine match_partition_rules
+# pattern, SNIPPETS.md [1]): rules are (regex, PartitionSpec) pairs matched
+# against 'layer_0/w'-style param paths, first match wins
+# ----------------------------------------------------------------------------
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "name"):
+        return str(k.name)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def param_path(path) -> str:
+    return "/".join(_key_str(k) for k in path)
+
+
+def match_partition_rules(rules, params):
+    """Pytree of PartitionSpec for `params` from (regex, spec) rules.
+
+    Paths are '/'-joined dict keys ('layer_0/linear1/w'); scalars are never
+    partitioned; an unmatched leaf is an error (rules should end with a
+    catch-all ('.', P()))."""
+    def spec_of(path, leaf):
+        name = param_path(path)
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0:
+            return P()
+        for rule, ps in rules:
+            if re.search(rule, name) is not None:
+                return ps
+        raise ValueError(f"no partition rule matched param {name!r}")
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_of(path, leaf) for path, leaf in flat])
+
+
+def gnn_partition_rules(spec, T: int):
+    """(regex, PartitionSpec) rules for a ModelSpec under a feat axis of
+    size T. Weight matrices shard along the dimension the per-layer psum
+    contracts over — the input-feature rows for GCN/SAGE/dense layers
+    ([fin, fout] -> P('feat', None); 'column-wise' in the torch [out, in]
+    convention), the head dimension for GAT ([fin, H*F'] -> P(None, 'feat'),
+    head-aligned because heads % T == 0). attn vectors and the per-head GAT
+    bias follow their heads; plain biases and norm params replicate (the
+    catch-all)."""
+    rules = []
+    for i, ok in enumerate(shardable_layers(spec, T)):
+        if not ok:
+            continue
+        if spec.model == "gat" and i < spec.n_graph_layers:
+            rules += [(rf"^layer_{i}/w$", P(None, FEAT_AXIS)),
+                      (rf"^layer_{i}/attn_[lr]$", P(FEAT_AXIS, None)),
+                      (rf"^layer_{i}/bias$", P(FEAT_AXIS))]
+        elif (spec.model == "graphsage" and i < spec.n_graph_layers
+              and not (spec.use_pp and i == 0)):
+            rules += [(rf"^layer_{i}/linear[12]/w$", P(FEAT_AXIS, None))]
+        else:
+            rules += [(rf"^layer_{i}/w$", P(FEAT_AXIS, None))]
+    rules.append((r".", P()))
+    return rules
+
+
+def param_specs_for(spec, T: int, params_abs=None):
+    """PartitionSpec pytree for init_params(spec)'s tree under a T-wide feat
+    axis. `params_abs`: an abstract or concrete params tree; derived via
+    eval_shape when omitted (imports models.gnn lazily — gnn.py imports the
+    predicates above, so the top level must stay acyclic)."""
+    if params_abs is None:
+        from bnsgcn_tpu.models.gnn import init_params
+        params_abs = jax.eval_shape(
+            lambda: init_params(jax.random.key(0), spec))[0]
+    return match_partition_rules(gnn_partition_rules(spec, T), params_abs)
+
+
+# ----------------------------------------------------------------------------
+# placement: host trees -> device arrays under the rules (params), or under
+# a placed template's shardings (optimizer state, resume/rollback restores)
+# ----------------------------------------------------------------------------
+
+def place_params(params_host, mesh: Mesh, spec, specs=None):
+    """Device-place a host params tree with the feat partition rules
+    (replicated over 'replicas'/'parts', sharded over 'feat' where the rules
+    say so). Checkpoints stay feat-invariant: jax.device_get of a sharded
+    single-host array assembles the FULL array, so saves are always
+    unsharded and restore into any mesh shape."""
+    if specs is None:
+        specs = param_specs_for(spec, n_feat(mesh), params_host)
+    return jax.tree.map(
+        lambda v, ps: jax.device_put(jnp.asarray(v), NamedSharding(mesh, ps)),
+        params_host, specs)
+
+
+def place_like(host_tree, sharding_tree):
+    """Re-place a restored host tree under a captured sharding tree (the
+    feat-aware analog of run.py's place_replicated restore sites)."""
+    return jax.tree.map(
+        lambda v, sh: jax.device_put(jnp.asarray(v), sh),
+        host_tree, sharding_tree)
+
+
+def place_state_like(state_host, params_placed, mesh: Mesh):
+    """Device-place an optimizer-state tree: leaves living at a params path
+    SUFFIX with a matching shape (optax mu/nu subtrees mirror the params
+    tree) adopt that param's sharding; everything else (step counts, empty
+    states) replicates. Keeps Adam moments sharded exactly like their
+    weights without optax-version-specific structure knowledge."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params_placed)
+    by_path = {}
+    for path, leaf in flat:
+        by_path[tuple(_key_str(k) for k in path)] = (leaf.shape, leaf.sharding)
+    rep = NamedSharding(mesh, P())
+
+    def put(path, leaf):
+        keys = tuple(_key_str(k) for k in path)
+        shape = getattr(leaf, "shape", ())
+        for n in range(len(keys), 0, -1):
+            hit = by_path.get(keys[-n:])
+            if hit is not None and hit[0] == tuple(shape):
+                return jax.device_put(jnp.asarray(leaf), hit[1])
+        return jax.device_put(jnp.asarray(leaf), rep)
+
+    flat_s, treedef = jax.tree_util.tree_flatten_with_path(state_host)
+    return jax.tree_util.tree_unflatten(
+        treedef, [put(path, leaf) for path, leaf in flat_s])

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 
 
 def grad_reduce_axes(axis_name: str = "parts",
-                     replica_axis: str | None = None):
+                     replica_axis: str | None = None,
+                     feat_axis: str | None = None):
     """Mesh axes of the ONE fused gradient/loss psum.
 
     On the 2-D ('replicas', 'parts') mesh (parallel/replicas.py) the
@@ -36,11 +37,18 @@ def grad_reduce_axes(axis_name: str = "parts",
     axes and the 1/n_replicas rescale rides the existing 1/n_train scalar —
     never a second collective (XLA emits one all-reduce over the full mesh,
     which it can still overlap with the backward exactly as on the 1-D
-    path). replica_axis=None returns the bare parts axis: the historical
+    path). The 3-D mesh's 'feat' axis (parallel/feat.py) folds in the same
+    way: per-device losses are identical along 'feat' (each layer already
+    psummed its partials), so spanning the axis here and riding a
+    1/n_feat rescale on the same 1/n_train scalar keeps the per-step
+    gradient reduce ONE collective over the whole mesh — replicated params'
+    AD transpose emits a single all-reduce, never a second feat-only hop.
+    replica_axis=feat_axis=None returns the bare parts axis: the historical
     1-D reduction, bit-identical."""
-    if replica_axis is None:
+    if replica_axis is None and feat_axis is None:
         return axis_name
-    return (replica_axis, axis_name)
+    axes = [a for a in (replica_axis, axis_name, feat_axis) if a is not None]
+    return tuple(axes)
 
 
 def psum_gradients(grads, axis_name="parts", n_train: int | None = None):

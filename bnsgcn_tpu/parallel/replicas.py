@@ -23,6 +23,11 @@ axis_name='parts' automatically act within each replica's sub-group.
 the historical path by construction (same Mesh, same specs, same compiled
 program), which tests/test_replicas.py pins across the full halo-strategy x
 wire-codec matrix.
+
+PR 6 grew `make_mesh` a third, INNERMOST 'feat' axis (parallel/feat.py):
+hidden dimensions shard T-ways with one per-layer psum on the fastest ICI
+hop; `n_feat == 1` likewise constructs no axis at all (tests/test_feat.py
+pins the bit-identity).
 """
 
 from __future__ import annotations
@@ -31,36 +36,51 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bnsgcn_tpu.parallel.feat import FEAT_AXIS, n_feat as mesh_n_feat
 from bnsgcn_tpu.parallel.mesh import make_parts_mesh
 
 REPLICA_AXIS = "replicas"
 PARTS_AXIS = "parts"
 
 
-def make_mesh(n_parts: int, n_replicas: int = 1, devices=None) -> Mesh:
-    """('replicas', 'parts') mesh of n_replicas x n_parts devices.
+def make_mesh(n_parts: int, n_replicas: int = 1, n_feat: int = 1,
+              devices=None) -> Mesh:
+    """Up-to-3-D ('replicas', 'parts', 'feat') mesh of R x P x T devices.
 
-    n_replicas == 1 (the default) delegates to `make_parts_mesh`: the 1-D
-    ('parts',) mesh, so every existing call site and compiled program is
-    unchanged unless a second axis was explicitly requested.
+    Axes are constructed innermost-first only as requested: n_feat == 1 and
+    n_replicas == 1 (the defaults) delegate to the 2-D / 1-D constructors,
+    so every existing call site and compiled program is unchanged unless an
+    extra axis was explicitly asked for (tests pin --feat 1 / --replicas 1
+    bitwise against the historical paths).
 
-    Replicas take the outer axis: with `jax.distributed` multi-host device
-    ordering (process-major), consecutive devices land in the same replica
-    row, keeping the per-layer halo exchange on the fast intra-slice hop and
-    only the once-per-step fused gradient reduce on the slow outer hop."""
-    if n_replicas <= 1:
+    Axis order encodes the traffic hierarchy: 'feat' is INNERMOST — its
+    per-layer partial psum (parallel/feat.py) is the most latency-sensitive
+    collective and gets the fastest ICI hop; the per-layer halo all_to_all
+    rides the middle 'parts' hop; 'replicas' stay OUTER (their only traffic
+    is the once-per-step fused gradient reduce, which tolerates DCN). With
+    `jax.distributed` process-major device ordering, consecutive devices
+    therefore land in the same (replica, part) feat group."""
+    if n_feat <= 1 and n_replicas <= 1:
         return make_parts_mesh(n_parts, devices)
     if devices is None:
         devices = jax.devices()
-    need = n_parts * n_replicas
+    need = n_parts * n_replicas * n_feat
     if len(devices) < need:
+        shape = (f"{n_replicas} replicas x {n_parts} partitions"
+                 + (f" x {n_feat} feat shards" if n_feat > 1 else ""))
         raise ValueError(
-            f"need >= {need} devices for {n_replicas} replicas x {n_parts} "
-            f"partitions, have {len(devices)}; lower --replicas (devices // "
-            f"n_parts = {len(devices) // max(n_parts, 1)} fit) or use a CPU "
-            f"mesh via XLA_FLAGS=--xla_force_host_platform_device_count={need}")
-    arr = np.asarray(devices[:need]).reshape(n_replicas, n_parts)
-    return Mesh(arr, (REPLICA_AXIS, PARTS_AXIS))
+            f"need >= {need} devices for {shape}, have {len(devices)}; "
+            f"lower --replicas/--feat or use a CPU mesh via "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need}")
+    if n_feat <= 1:
+        arr = np.asarray(devices[:need]).reshape(n_replicas, n_parts)
+        return Mesh(arr, (REPLICA_AXIS, PARTS_AXIS))
+    arr = np.asarray(devices[:need]).reshape(n_replicas, n_parts, n_feat)
+    if n_replicas <= 1:
+        # no replica axis requested: a 2-D ('parts', 'feat') mesh, so the
+        # replica-free code paths (axis lookups, dedup) stay untouched
+        return Mesh(arr[0], (PARTS_AXIS, FEAT_AXIS))
+    return Mesh(arr, (REPLICA_AXIS, PARTS_AXIS, FEAT_AXIS))
 
 
 def n_replicas(mesh: Mesh) -> int:
@@ -78,33 +98,45 @@ def replica_axis(mesh: Mesh):
 
 
 def mesh_desc(mesh: Mesh) -> str:
-    """Human-readable mesh shape for run headers: '2x4 replicas x parts'
-    on a 2-D mesh, '4 parts' on the historical 1-D mesh."""
+    """Human-readable mesh shape for run headers: '2x4x2 replicas x parts
+    x feat' on a 3-D mesh, '2x4 replicas x parts' on 2-D, '4 parts' on the
+    historical 1-D mesh."""
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    if REPLICA_AXIS in shape:
-        return (f"{shape[REPLICA_AXIS]}x{shape[PARTS_AXIS]} "
-                f"replicas x parts")
-    return f"{shape[PARTS_AXIS]} parts"
+    axes = [(REPLICA_AXIS, "replicas"), (PARTS_AXIS, "parts"),
+            (FEAT_AXIS, "feat")]
+    present = [(shape[a], label) for a, label in axes if a in shape]
+    if len(present) == 1:
+        return f"{present[0][0]} parts"
+    return ("x".join(str(n) for n, _ in present) + " "
+            + " x ".join(label for _, label in present))
 
 
 def stacked_spec(mesh: Mesh) -> P:
-    """PartitionSpec stacking per-device rows along dim 0: (replicas, parts)
-    together on a 2-D mesh (global [R*P, ...], replica-major), plain
+    """PartitionSpec stacking per-device rows along dim 0: every mesh axis
+    together (global [R*P*T, ...], replica-major / feat-minor), plain
     ('parts',) on 1-D. Used as the shard_map out_spec for outputs that
     genuinely differ per replica (training-mode logits under independent
-    BNS draws, the exchange-only microbench sum)."""
-    if REPLICA_AXIS in mesh.axis_names:
-        return P((REPLICA_AXIS, PARTS_AXIS))
-    return P(PARTS_AXIS)
+    BNS draws, the exchange-only microbench sum); feat shards produce
+    identical post-psum copies that `dedup_replica0` strides past."""
+    axes = tuple(a for a in (REPLICA_AXIS, PARTS_AXIS, FEAT_AXIS)
+                 if a in mesh.axis_names)
+    if axes == (PARTS_AXIS,):
+        return P(PARTS_AXIS)
+    return P(axes)
 
 
 def dedup_replica0(out, mesh: Mesh, n_parts: int):
-    """Replica 0's [n_parts, ...] slice of a `stacked_spec` output.
+    """(Replica 0, feat shard 0)'s [n_parts, ...] slice of a `stacked_spec`
+    output.
 
-    Metric/eval outputs are de-duplicated to replica 0 so the host-side
-    reporting pipeline (accuracy logs, result files, _gather_logits) sees
-    the same [P, ...] shape regardless of the replica axis. `stacked_spec`
-    is replica-major, so replica 0 is the leading n_parts rows."""
-    if REPLICA_AXIS in mesh.axis_names:
-        return out[:n_parts]
-    return out
+    Metric/eval outputs are de-duplicated so the host-side reporting
+    pipeline (accuracy logs, result files, _gather_logits) sees the same
+    [P, ...] shape regardless of the extra axes. `stacked_spec` is
+    replica-major with feat innermost, so replica 0 is the leading
+    n_parts * T rows and part p's feat-0 copy sits at row p * T."""
+    T = mesh_n_feat(mesh)
+    if T > 1:
+        out = out[:n_parts * T:T]
+    elif REPLICA_AXIS not in mesh.axis_names:
+        return out
+    return out[:n_parts]

@@ -32,7 +32,7 @@ import numpy as np
 
 from bnsgcn_tpu import checkpoint as ckpt
 from bnsgcn_tpu import resilience
-from bnsgcn_tpu.config import Config
+from bnsgcn_tpu.config import Config, ConfigError
 from bnsgcn_tpu.data.artifacts import (PartitionArtifacts, build_artifacts,
                                        load_artifacts, save_artifacts)
 from bnsgcn_tpu.data.datasets import inductive_split, load_data
@@ -41,6 +41,7 @@ from bnsgcn_tpu.data.partitioner import partition_graph
 from bnsgcn_tpu.evaluate import evaluate_induc, evaluate_mesh, evaluate_trans
 from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
 from bnsgcn_tpu.parallel import coord as coord_mod
+from bnsgcn_tpu.parallel import feat as feat_mod
 from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc
 from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns, init_training,
                                 local_part_ids, param_global_norm, place_blocks,
@@ -103,6 +104,34 @@ def _final_best_payload(cfg: Config, best_acc: float, log):
     if abs(float(payload.get("best_acc", -1.0)) - best_acc) >= 1e-9:
         return None
     return payload
+
+
+def check_mesh_budget(cfg: Config, devices=None) -> None:
+    """ONE named config error when R x P x T exceeds the device budget,
+    raised before any mesh/axis-specific constructor can fail with its own
+    partial message (previously only the replicas path raised, from inside
+    make_mesh). Lists which axis to shrink; main.py maps ConfigError to
+    exit 2."""
+    have = len(devices if devices is not None else jax.devices())
+    R, P_, T = max(cfg.replicas, 1), max(cfg.n_partitions, 1), max(cfg.feat, 1)
+    need = R * P_ * T
+    if need <= have:
+        return
+    fixes = []
+    if T > 1 and R * P_ <= have:
+        fixes.append(f"--feat to <= {have // (R * P_)}")
+    if R > 1 and P_ * T <= have:
+        fixes.append(f"--replicas to <= {have // (P_ * T)}")
+    if P_ > have:
+        fixes.append(f"--n-partitions to <= {have} (re-partition the graph)")
+    if not fixes:
+        fixes.append(f"some axis so replicas*parts*feat <= {have}")
+    raise ConfigError(
+        f"mesh does not fit: --replicas {R} x --n-partitions {P_} x "
+        f"--feat {T} needs {need} devices, have {have}; shrink "
+        + " or ".join(fixes)
+        + (f", or use a CPU mesh via XLA_FLAGS="
+           f"--xla_force_host_platform_device_count={need}"))
 
 
 @dataclass
@@ -169,14 +198,18 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     train_g = g.subgraph(g.train_mask) if (cfg.inductive and g is not None) else g
 
     # ---- mesh + partition artifacts ----
-    # --replicas N > 1: 2-D ('replicas','parts') mesh — each replica row
-    # trains the same partitioned graph under an independent BNS draw and
-    # gradients are the fused cross-replica mean (parallel/replicas.py)
-    if cfg.replicas > 1 and multi_host:
+    # --replicas N > 1: each replica row trains the same partitioned graph
+    # under an independent BNS draw, gradients are the fused cross-replica
+    # mean (parallel/replicas.py). --feat T > 1: the innermost mesh axis
+    # shards hidden dimensions T-ways — zero boundary nodes on that axis,
+    # halo payloads H/T wide, one feat psum per layer (parallel/feat.py).
+    if (cfg.replicas > 1 or cfg.feat > 1) and multi_host:
         raise ValueError(
-            "--replicas > 1 is single-host for now (multi-host processes map "
-            "to parts slots only); run with --replicas 1 across hosts")
-    mesh = make_mesh(cfg.n_partitions, cfg.replicas, devices)
+            "--replicas/--feat > 1 are single-host for now (multi-host "
+            "processes map to parts slots only); run with --replicas 1 "
+            "--feat 1 across hosts")
+    check_mesh_budget(cfg, devices)
+    mesh = make_mesh(cfg.n_partitions, cfg.replicas, cfg.feat, devices)
     if multi_host and art is not None:
         n_local = len(local_part_ids(mesh))
         if art.feat.shape[0] != n_local:
@@ -311,18 +344,36 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         halo_label += "+ovl"
     if fns.n_replicas > 1:
         halo_label += f"+rep{fns.n_replicas}"
+    if fns.n_feat > 1:
+        halo_label += f"+feat{fns.n_feat}"
     # wire bytes are PER REPLICA per device (each replica row runs its own
     # parts-axis exchange) and reported exactly once — the replica axis adds
-    # one fused gradient all-reduce per step, never more halo traffic
+    # one fused gradient all-reduce per step, never more halo traffic. The
+    # feat axis SHRINKS the parts-axis payload instead: a feat-sharded
+    # layer's exchange ships its H/T activation slice, so the per-axis
+    # numbers below drop ~T x vs feat=1 (GAT exchanges stay full-width —
+    # that model shards heads, not the exchanged input).
+    T_fe = fns.n_feat
+
+    def _wire_w(fin):
+        # GAT exchanges its full-width input (it shards heads, not the
+        # exchanged activations); GCN/SAGE ship the H/T slice
+        return feat_mod.shard_width(fin, T_fe,
+                                    spec.model in ("gcn", "graphsage"))
+
     per_rep = "/replica" if fns.n_replicas > 1 else ""
+    hid_w = _wire_w(cfg.n_hidden)
+    feat_note = (f" (H/T={hid_w} of {cfg.n_hidden} on the parts wire: "
+                 f"~{cfg.n_hidden // max(hid_w, 1)}x less than feat=1)"
+                 if hid_w != cfg.n_hidden else "")
     log(f"Mesh: {mesh_desc(mesh)} | pad_inner={art.pad_inner} "
         f"pad_boundary={art.pad_boundary} pad_send={hspec.pad_send} "
         f"edges/part={art.pad_edges} | halo {halo_label}/{hspec.wire}: "
-        f"{wire_bytes(hspec, cfg.n_hidden, nb) / 1e6:.2f} MB/exchange/device{per_rep} "
-        f"at hidden width {cfg.n_hidden}"
+        f"{wire_bytes(hspec, hid_w, nb) / 1e6:.2f} MB/exchange/device{per_rep} "
+        f"at hidden width {cfg.n_hidden}" + feat_note
         + ("" if spec.use_pp or spec.model == "gat" else
-           f" ({wire_bytes(hspec, max(cfg.n_feat, 1), nb) / 1e6:.2f} MB at "
-           f"layer-0 feature width {cfg.n_feat})"))
+           f" ({wire_bytes(hspec, _wire_w(max(cfg.n_feat, 1)), nb) / 1e6:.2f}"
+           f" MB at layer-0 feature width {cfg.n_feat})"))
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
     mesh_eval = cfg.eval and cfg.eval_device == "mesh"
@@ -388,6 +439,25 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             "seed", {"seed": seed} if coord_rank == 0 else None)["seed"])
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     params, state, opt_state = init_training(cfg, spec, mesh, seed=seed, dtype=dtype)
+    # every resume/rollback below restores HOST trees back onto the mesh;
+    # feat-sharded meshes re-place them under the captured template
+    # shardings (weights + Adam moments sharded over 'feat' — checkpoints
+    # themselves are always saved unsharded via jax.device_get, so they
+    # stay feat-invariant); feat=1 keeps the historical replicated
+    # placement verbatim, including the multi-host local-data path
+    if cfg.feat > 1:
+        _p_sh = jax.tree.map(lambda x: x.sharding, params)
+        _o_sh = jax.tree.map(lambda x: x.sharding, opt_state)
+
+        def place_p(h):
+            return feat_mod.place_like(h, _p_sh)
+
+        def place_o(h):
+            return feat_mod.place_like(h, _o_sh)
+    else:
+        def place_p(h):
+            return place_replicated(h, mesh)
+        place_o = place_p
     start_epoch, best_acc, best_params = 0, 0.0, None
     retry_nonce = 0     # cumulative divergence-rollback count: folds the
                         # sampling/dropout key streams (resilience.py) and
@@ -458,8 +528,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 host = ckpt.restore_into(payload1, jax.device_get(params),
                                          jax.device_get(opt_state),
                                          jax.device_get(state))
-            params = place_replicated(host[0], mesh)
-            opt_state = place_replicated(host[1], mesh)
+            params = place_p(host[0])
+            opt_state = place_o(host[1])
             state = place_replicated(host[2], mesh)
             log(f"Resumed (agreed via coordinator) from {choice['file']} at "
                 f"epoch {start_epoch}")
@@ -526,8 +596,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 jax.device_get(params), jax.device_get(opt_state),
                 jax.device_get(state))
             host = multihost_utils.broadcast_one_to_all(host)
-            params = place_replicated(host[0], mesh)
-            opt_state = place_replicated(host[1], mesh)
+            params = place_p(host[0])
+            opt_state = place_o(host[1])
             state = place_replicated(host[2], mesh)
             start_epoch = int(have)
             best_acc = float(multihost_utils.broadcast_one_to_all(np.float64(
@@ -556,8 +626,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             p, o, s = ckpt.restore_into(payload, jax.device_get(params),
                                         jax.device_get(opt_state),
                                         jax.device_get(state))
-            params = place_replicated(p, mesh)
-            opt_state = place_replicated(o, mesh)
+            params = place_p(p)
+            opt_state = place_o(o)
             state = place_replicated(s, mesh)
             start_epoch = int(payload["epoch"]) + 1
             best_acc = float(payload["best_acc"])
@@ -634,10 +704,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     comm_t = 0.0
     res = RunResult()
     # widths of the per-layer exchanges: hidden-wide for layers >= 1, and a
-    # raw-feature-wide layer-0 exchange when use_pp is off
-    exch_widths = [cfg.n_hidden] * max(spec.n_graph_layers - 1, 0)
+    # raw-feature-wide layer-0 exchange when use_pp is off; feat-sharded
+    # layers ship their H/T slice, so the microbench must too
+    exch_widths = [_wire_w(cfg.n_hidden)] * max(spec.n_graph_layers - 1, 0)
     if not spec.use_pp and spec.model != "gat" and spec.n_graph_layers > 0:
-        exch_widths.append(max(cfg.n_feat, 1))
+        exch_widths.append(_wire_w(max(cfg.n_feat, 1)))
 
     # compile the comm microbenches outside the timed region
     for w in set(exch_widths):
@@ -793,8 +864,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                                                             *templates)
                     restart = int(decision["restart"])
                     retry_nonce = int(decision["nonce"])
-                    params = place_replicated(p_h, mesh)
-                    opt_state = place_replicated(o_h, mesh)
+                    params = place_p(p_h)
+                    opt_state = place_o(o_h)
                     state = place_replicated(s_h, mesh)
                     sample_key, drop_key = _fold_keys(retry_nonce)
                     if restart < loss_base:
@@ -809,8 +880,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 p_h, o_h, s_h, restart, retry_nonce = resil.rollback(
                     epoch, loss_f, jax.device_get(params),
                     jax.device_get(opt_state), jax.device_get(state))
-                params = place_replicated(p_h, mesh)
-                opt_state = place_replicated(o_h, mesh)
+                params = place_p(p_h)
+                opt_state = place_o(o_h)
                 state = place_replicated(s_h, mesh)
                 sample_key, drop_key = _fold_keys(retry_nonce)
                 # retried epochs get re-recorded on the healthy pass
@@ -1049,7 +1120,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # no reason to pin it in HBM during training)
             fns_e, blk_e, tf_e, art_e = (
                 _eval_resources(test_g, "-test") if cfg.inductive else eval_val)
-            pb = place_replicated(best_params, mesh)
+            pb = place_p(best_params)
             res.test_acc = evaluate_mesh("Test Result", fns_e.eval_forward,
                                          pb, state, blk_e, tf_e, art_e,
                                          ("test",))["test"]

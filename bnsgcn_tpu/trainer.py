@@ -41,6 +41,7 @@ from bnsgcn_tpu.parallel.halo import (HaloSpec, full_rate_spec, halo_apply,
                                       precompute_exchange)
 from bnsgcn_tpu.parallel.mesh import (make_parts_mesh, parts_sharding,
                                        replicated_sharding, shard_map)
+from bnsgcn_tpu.parallel import feat as feat_mod
 from bnsgcn_tpu.parallel.reducer import grad_reduce_axes
 from bnsgcn_tpu.parallel.replicas import (dedup_replica0, stacked_spec,
                                           n_replicas as mesh_n_replicas,
@@ -51,6 +52,10 @@ from bnsgcn_tpu.parallel.replicas import (dedup_replica0, stacked_spec,
 # coverage — 0.87 vs 1.67 s/epoch — and the marginal-tile cost model puts
 # break-even near half coverage; below it the gathers-only ELL is safer)
 AUTO_HYBRID_MIN_COVERAGE = 0.5
+
+# configurations already warned about non-feat-shardable layers (the note
+# fires once per config, not once per build_step_fns call)
+_warned_unshardable: set = set()
 
 
 # ----------------------------------------------------------------------------
@@ -155,12 +160,20 @@ class StepFns:
                               # against means of 1-D runs through this
     n_replicas: int = 1       # replica-axis size of the mesh the fns compiled
                               # for (parallel/replicas.py; 1 = historical 1-D)
+    n_feat: int = 1           # feat-axis size (parallel/feat.py): shardable
+                              # layers run on H/T activation slices with
+                              # feat-sharded weights; 1 = historical paths
+    param_spec: Any = None    # PartitionSpec pytree the params enter the
+                              # shard_map'd loss with (P() when n_feat == 1) —
+                              # run.py/tests place params and optimizer state
+                              # with it so checkpoints stay feat-invariant
 
 
 def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
                rng, edge_chunk: int, training: bool, aggregate=None,
                gat_ell=None, remat: bool = False,
-               agg_exchange=None, n_replicas: int = 1) -> GraphEnv:
+               agg_exchange=None, n_replicas: int = 1,
+               feat_axis=None, n_feat: int = 1) -> GraphEnv:
     return GraphEnv(
         src=blk.get("src"), dst=blk.get("dst"), n_dst=hspec.pad_inner,
         in_norm=blk["in_norm"], out_norm=blk["out_norm"],
@@ -172,6 +185,7 @@ def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
         aggregate=aggregate, gat_ell=gat_ell, remat=remat,
         replica_axis=hspec.replica_axis, n_replicas=n_replicas,
         agg_exchange=agg_exchange,
+        feat_axis=feat_axis, n_feat_shards=n_feat,
     )
 
 
@@ -279,12 +293,18 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     # bit-identical code path.
     n_rep = mesh_n_replicas(mesh)
     rep_axis = mesh_replica_axis(mesh)
-    if n_rep > 1 and jax.process_count() > 1:
+    # 3-D mesh feat axis (parallel/feat.py): shardable layers slice their
+    # activations to H/T columns (the halo exchange ships H/T-width payloads)
+    # and psum weight-shard partials over 'feat' once per layer; the BNS
+    # sampling keys never fold the feat index — every shard of a (replica,
+    # part) must draw the SAME boundary sample.
+    n_fe = feat_mod.n_feat(mesh)
+    fe_axis = feat_mod.feat_axis(mesh)
+    if (n_rep > 1 or n_fe > 1) and jax.process_count() > 1:
         raise ValueError(
-            "replica-axis meshes are single-host for now: multi-host partial "
-            "artifact loading maps processes to parts slots only (use "
-            "--replicas 1 across hosts, or give every replica row its own "
-            "single-host run)")
+            "replica/feat-axis meshes are single-host for now: multi-host "
+            "partial artifact loading maps processes to parts slots only "
+            "(use --replicas 1 --feat 1 across hosts)")
     hspec, tables = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, rate,
                                    strategy=halo_strategy, wire=cfg.halo_wire,
                                    replica_axis=rep_axis)
@@ -292,11 +312,32 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     n_train = max(art.n_train, 1)
     multilabel = art.multilabel
     axis = hspec.axis_name
-    loss_axes = grad_reduce_axes(axis, rep_axis)   # ONE fused psum; /n_rep
-    loss_denom = n_train * n_rep                   # rides the /n_train scale
-    blk_spec = P("parts")                          # replicated over replicas
+    # ONE fused psum spanning every mesh axis: /n_rep (gradient mean over
+    # replicas) and /n_fe (feat shards hold identical post-psum losses)
+    # both ride the existing /n_train scale — never a second collective
+    loss_axes = grad_reduce_axes(axis, rep_axis, fe_axis)
+    loss_denom = n_train * n_rep * n_fe
+    blk_spec = P("parts")                          # replicated over replicas+feat
     stacked = stacked_spec(mesh)                   # per-replica-varying outs
     rep = P()
+    # params enter the shard_map'd loss feat-sharded where the regex rules
+    # say so (weights row/head-sharded, biases and norms replicated); P()
+    # everywhere at n_fe == 1 — the historical replicated in_spec verbatim
+    param_spec = rep
+    if n_fe > 1:
+        param_spec = feat_mod.param_specs_for(spec, n_fe)
+        skipped = [i for i, ok in enumerate(
+            feat_mod.shardable_layers(spec, n_fe)) if not ok]
+        warn_key = (spec.model, spec.layer_sizes, spec.heads, n_fe)
+        if (skipped and jax.process_index() == 0
+                and warn_key not in _warned_unshardable):
+            # once per configuration: run_training rebuilds step fns for
+            # every eval resource and bench per variant — the diagnostic is
+            # about the config, not the build
+            _warned_unshardable.add(warn_key)
+            print(f"feat={n_fe}: layer(s) {skipped} keep full width (input "
+                  f"width/heads not divisible by {n_fe}); their params stay "
+                  f"replicated", file=sys.stderr)
 
     # scatter-free SpMM layouts (GCN/SAGE aggregation path): 'ell' (bucketed
     # gathers) or 'hybrid' (dense int8 adjacency tiles on the MXU + ELL
@@ -625,7 +666,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
                          aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
                          remat=cfg.remat, agg_exchange=_split_agg_for(blk, plan),
-                         n_replicas=n_rep)
+                         n_replicas=n_rep, feat_axis=fe_axis, n_feat=n_fe)
         logits, new_state = apply_model(params, state, spec, blk["feat"], env)
         if multilabel:
             ls = bce_sum(logits, blk["label"], blk["train_mask"])
@@ -640,7 +681,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     sharded_loss = shard_map(
         local_loss, mesh=mesh,
-        in_specs=(rep, rep, blk_spec, rep, rep, rep, rep),
+        in_specs=(param_spec, rep, blk_spec, rep, rep, rep, rep),
         out_specs=(rep, rep))
 
     def global_loss(params, state, blk, tables, epoch, sample_key, drop_key):
@@ -675,7 +716,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
                          aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
                          agg_exchange=_split_agg_for(blk, plan),
-                         n_replicas=n_rep)
+                         n_replicas=n_rep, feat_axis=fe_axis, n_feat=n_fe)
         logits, _ = apply_model(params, state, spec, blk["feat"], env)
         return logits[None]
 
@@ -687,7 +728,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         f = shard_map(
             partial(local_forward),
             mesh=mesh,
-            in_specs=(rep, rep, blk_spec, rep, rep, rep, rep),
+            in_specs=(param_spec, rep, blk_spec, rep, rep, rep, rep),
             out_specs=stacked)
         out = f(params, state, blk, tables, epoch, sample_key, drop_key)
         return dedup_replica0(out, mesh, hspec.n_parts)
@@ -704,7 +745,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                               jax.random.key(0))
         env = _local_env(spec, hspec_full, blk, plan, None, cfg.edge_chunk,
                          False, aggregate=_aggregate_for(blk),
-                         gat_ell=_gat_ell_for(blk))
+                         gat_ell=_gat_ell_for(blk),
+                         n_replicas=n_rep, feat_axis=fe_axis, n_feat=n_fe)
         logits, _ = apply_model(params, state, spec, blk["feat"], env)
         return logits[None]
 
@@ -713,7 +755,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         # full-rate eval is deterministic, so every replica computes the
         # same logits; metrics de-duplicate to replica 0's copy
         f = shard_map(local_eval, mesh=mesh,
-                          in_specs=(rep, rep, blk_spec, rep),
+                          in_specs=(param_spec, rep, blk_spec, rep),
                           out_specs=stacked)
         return dedup_replica0(f(params, state, blk, tables_full),
                               mesh, hspec.n_parts)
@@ -775,7 +817,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                                  else ()),
                   overlap=overlap,
                   loss_and_grad=loss_and_grad,
-                  n_replicas=n_rep)
+                  n_replicas=n_rep,
+                  n_feat=n_fe,
+                  param_spec=param_spec)
     return fns, hspec, tables, tables_full
 
 
@@ -797,10 +841,21 @@ def param_global_norm(params) -> jax.Array:
 def init_training(cfg: Config, spec: ModelSpec, mesh: Mesh, seed: int = 0,
                   dtype=jnp.float32):
     """Replicated params / state / optimizer state (reference train.py:331-338).
-    The optimizer is the same make_tx(cfg) the train step uses."""
+    The optimizer is the same make_tx(cfg) the train step uses.
+
+    Feat-axis meshes (parallel/feat.py) place weight leaves SHARDED over
+    'feat' per the regex partition rules, with the Adam moments adopting
+    their weight's sharding; init still happens on the full host tree, so a
+    feat=T run initializes bit-identically to feat=1 and checkpoints stay
+    feat-invariant."""
     params, state = init_params(jax.random.key(seed), spec, dtype)
     opt_state = make_tx(cfg).init(params)
-    params = place_replicated(params, mesh)
-    state = place_replicated(state, mesh)
-    opt_state = place_replicated(opt_state, mesh)
+    if feat_mod.n_feat(mesh) > 1:
+        params = feat_mod.place_params(params, mesh, spec)
+        state = place_replicated(state, mesh)
+        opt_state = feat_mod.place_state_like(opt_state, params, mesh)
+    else:
+        params = place_replicated(params, mesh)
+        state = place_replicated(state, mesh)
+        opt_state = place_replicated(opt_state, mesh)
     return params, state, opt_state

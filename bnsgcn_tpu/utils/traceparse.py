@@ -241,46 +241,63 @@ def _replica_groups(ev):
     return None
 
 
-def classify_axis(groups, n_parts: int, n_replicas: int = 1) -> str:
+def classify_axis(groups, n_parts: int, n_replicas: int = 1,
+                  n_feat: int = 1) -> str:
     """Mesh axis a collective's replica_groups reduce over, for the
-    ('replicas', 'parts') device order of parallel/replicas.make_mesh
-    (device id = r * n_parts + p, replicas outer):
+    ('replicas', 'parts', 'feat') device order of parallel/replicas.
+    make_mesh (device id = (r * n_parts + p) * n_feat + f, replicas outer,
+    feat innermost):
 
-      * groups of n_parts CONSECUTIVE ids        -> 'parts' (halo traffic,
-        one group per replica row);
-      * groups of n_replicas ids at stride P     -> 'replicas' (a pure
+      * one group of every device               -> the fused gradient/loss
+        reduce: 'replicas x parts x feat' on a 3-D mesh, 'replicas x parts'
+        / 'parts x feat' on the 2-D meshes, plain 'parts' on 1-D;
+      * groups of n_feat CONSECUTIVE ids aligned to n_feat -> 'feat' (the
+        per-layer partial psum of the tensor axis);
+      * groups of n_parts ids at stride n_feat, first id inside the feat-0
+        block of its replica row           -> 'parts' (halo traffic, one
+        group per (replica, feat) lane);
+      * groups of n_replicas ids at stride P*T   -> 'replicas' (a pure
         replica-axis reduce — the fused trainer never emits one, so seeing
-        it flags an unfused double collective);
-      * one group of every device               -> 'replicas x parts' (the
-        fused gradient/loss reduce; plain 'parts' on a 1-D mesh).
+        it flags an unfused double collective).
     """
     if not groups or not groups[0]:
         return "unknown"
     size = len(groups[0])
     if any(len(g) != size for g in groups):
         return "unknown"
-    if size == n_parts * n_replicas:
-        return "replicas x parts" if n_replicas > 1 else "parts"
+    full = n_parts * n_replicas * n_feat
+    if size == full:
+        label = [n for n, on in (("replicas", n_replicas > 1), ("parts", True),
+                                 ("feat", n_feat > 1)) if on]
+        return " x ".join(label) if len(label) > 1 else "parts"
+    if n_feat > 1 and size == n_feat and all(
+            g == list(range(g[0], g[0] + n_feat)) and g[0] % n_feat == 0
+            for g in groups):
+        return "feat"
     if size == n_parts and all(
-            g == list(range(g[0], g[0] + n_parts)) and g[0] % n_parts == 0
+            all(b - a == n_feat for a, b in zip(g, g[1:]))
+            and g[0] % (n_parts * n_feat) < n_feat
             for g in groups):
         return "parts"
     if n_replicas > 1 and size == n_replicas and all(
-            all(b - a == n_parts for a, b in zip(g, g[1:])) for g in groups):
+            all(b - a == n_parts * n_feat for a, b in zip(g, g[1:]))
+            for g in groups):
         return "replicas"
     return "unknown"
 
 
-def comm_by_axis(events, n_parts: int, n_replicas: int = 1):
+def comm_by_axis(events, n_parts: int, n_replicas: int = 1, n_feat: int = 1):
     """Device collective time grouped by mesh axis: {axis: {kind: us}}.
 
     `kind` is 'exchange' (all-to-all / collective-permute — the per-layer
-    halo hop) or 'reduce' (all-reduce family — the fused gradient mean).
-    Axis comes from the event's replica_groups when the trace carries HLO
-    metadata; otherwise the op kind decides (halo exchanges only ever ride
-    'parts'; the trainer's one reduce spans the full mesh), so a pod trace
-    still separates parts-axis halo traffic from the replica-axis gradient
-    fusion even when the profiler strips attributes.
+    halo hop) or 'reduce' (all-reduce family — the per-layer feat psum of a
+    --feat run, or the fused gradient mean). Axis comes from the event's
+    replica_groups when the trace carries HLO metadata — on a 3-D mesh this
+    is what splits halo ('parts') vs feat-psum ('feat') vs gradient
+    ('replicas x parts x feat') time; otherwise the op kind decides (halo
+    exchanges only ever ride 'parts'; a reduce defaults to the full-mesh
+    gradient label — without groups a feat psum is indistinguishable from
+    it, so --by-axis needs an attribute-carrying trace to separate them).
 
     Spans are reduced with the SAME min-over-lanes estimator as
     `program_cost`: lane i's k-th collective span includes its rendezvous
@@ -308,11 +325,14 @@ def comm_by_axis(events, n_parts: int, n_replicas: int = 1):
             continue
         groups = _replica_groups(ev)
         if groups is not None:
-            axis = classify_axis(groups, n_parts, n_replicas)
+            axis = classify_axis(groups, n_parts, n_replicas, n_feat)
         elif kind == "exchange":
             axis = "parts"
         else:
-            axis = "replicas x parts" if n_replicas > 1 else "parts"
+            label = [n for n, on in (("replicas", n_replicas > 1),
+                                     ("parts", True), ("feat", n_feat > 1))
+                     if on]
+            axis = " x ".join(label) if len(label) > 1 else "parts"
         by_key.setdefault((axis, kind), {}).setdefault(lane, []).append(
             (float(ev["ts"]), float(ev.get("dur", 0.0))))
     out = {}

@@ -104,12 +104,12 @@ def test_gat_custom_vjp_matches_ad():
     for drop in (0.0, 0.4):
         def loss_custom(z, el, er):
             out = gat_ell_attention(spec_e, arrays, z, el, er, pres, key,
-                                    drop, True, 0.2)
+                                    None, drop, True, 0.2)
             return jnp.sum(out * cot)
 
         def loss_ad(z, el, er):
             out, _ = _gat_fwd_impl(spec_e, arrays, z, el, er, pres, key,
-                                   drop, True, 0.2)
+                                   None, drop, True, 0.2)
             return jnp.sum(out * cot)
 
         v_c, g_c = jax.value_and_grad(loss_custom, argnums=(0, 1, 2))(z, el, er)

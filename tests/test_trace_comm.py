@@ -181,6 +181,54 @@ def test_comm_by_axis_classifies_replica_groups():
     assert comm_by_axis(ev3, P, R)["parts"]["exchange"] == 10
 
 
+def test_comm_by_axis_classifies_3_axis_groups():
+    """3-D ('replicas','parts','feat') mesh observability: a synthetic
+    3-axis trace splits halo ('parts'), per-layer feat psum ('feat') and
+    fused gradient ('replicas x parts x feat') device time so --by-axis can
+    report each. Device id = (r*P + p)*T + f (replicas outer, feat inner —
+    parallel/replicas.make_mesh)."""
+    from bnsgcn_tpu.utils.traceparse import classify_axis, comm_by_axis
+
+    P, R, T = 2, 2, 2        # ids: r0p0={0,1} r0p1={2,3} r1p0={4,5} r1p1={6,7}
+    # feat groups: T consecutive ids per (replica, part), aligned to T
+    assert classify_axis([[0, 1], [2, 3], [4, 5], [6, 7]], P, R, T) == "feat"
+    # parts groups: stride-T pairs, one per (replica, feat) lane
+    assert classify_axis([[0, 2], [1, 3], [4, 6], [5, 7]], P, R, T) == "parts"
+    # replica groups: stride P*T
+    assert classify_axis([[0, 4], [1, 5], [2, 6], [3, 7]], P, R, T) == "replicas"
+    # the fused gradient reduce spans all three axes
+    assert classify_axis([[0, 1, 2, 3, 4, 5, 6, 7]], P, R, T) == \
+        "replicas x parts x feat"
+    # replica-free (1, P, T) mesh labels
+    assert classify_axis([[0, 1, 2, 3]], P, 1, T) == "parts x feat"
+    assert classify_axis([[0, 1], [2, 3]], P, 1, T) == "feat"
+    assert classify_axis([[0, 2], [1, 3]], P, 1, T) == "parts"
+    # feat-misaligned consecutive pairs are not a feat group
+    assert classify_axis([[1, 2], [5, 6]], P, R, T) == "unknown"
+    # 2-D calls (no feat arg) keep their historical labels
+    assert classify_axis([[0, 1, 2, 3], [4, 5, 6, 7]], 4, 2) == "parts"
+
+    ev = [_meta(1, 10, "dev0")]
+    a2a = _ev(1, 10, "all-to-all.1", 100.0, 30)
+    a2a["args"] = {"long_name":
+                   "all-to-all, replica_groups={{0,2},{1,3},{4,6},{5,7}}"}
+    ev.append(a2a)
+    fpsum = _ev(1, 10, "all-reduce.2", 200.0, 13)
+    fpsum["args"] = {"long_name":
+                     "all-reduce, replica_groups={{0,1},{2,3},{4,5},{6,7}}"}
+    ev.append(fpsum)
+    grad = _ev(1, 10, "all-reduce.3", 300.0, 9)
+    grad["args"] = {"long_name":
+                    "all-reduce, replica_groups={{0,1,2,3,4,5,6,7}}"}
+    ev.append(grad)
+    # attribute-stripped reduce: op-kind fallback lands on the full mesh
+    ev.append(_ev(1, 10, "all-reduce.4", 400.0, 4))
+    table = comm_by_axis(ev, P, R, T)
+    assert table["parts"]["exchange"] == 30
+    assert table["feat"]["reduce"] == 13
+    assert table["replicas x parts x feat"]["reduce"] == 9 + 4
+
+
 def test_step_comm_per_epoch_none_without_exchange_events(tmp_path):
     """A trace window holding train_step launches but NO device exchange
     events (observed when the step compiles inside the window on XLA:CPU)

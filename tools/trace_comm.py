@@ -31,8 +31,9 @@ trace) and prints the top op categories by device time for perf work.
 Usage:
   python tools/trace_comm.py --run                 # full cross-check table
   python tools/trace_comm.py --parse /tmp/hw_trace --breakdown
-  python tools/trace_comm.py --by-axis /tmp/hw_trace --parts 4 --replicas 2
-                                # parts-axis halo vs replica-axis grad traffic
+  python tools/trace_comm.py --by-axis /tmp/hw_trace --parts 4 --replicas 2 \
+                             --feat 2
+                # parts-axis halo vs per-layer feat psums vs gradient reduce
 """
 
 from __future__ import annotations
@@ -145,12 +146,14 @@ def main():
                          "(per-step exchange/interior/frontier/hidden ms)")
     ap.add_argument("--by-axis", type=str, default="",
                     help="group a trace's collective device time by mesh "
-                         "axis (parts-axis halo traffic vs the fused "
-                         "replicas x parts gradient reduce of a --replicas "
-                         "run); pass --parts / --replicas matching the "
-                         "traced mesh")
+                         "axis (parts-axis halo traffic vs the per-layer "
+                         "'feat' psums of a --feat run vs the fused "
+                         "full-mesh gradient reduce); pass --parts / "
+                         "--replicas / --feat matching the traced mesh")
     ap.add_argument("--replicas", type=int, default=1,
                     help="replica-axis size of the traced mesh (--by-axis)")
+    ap.add_argument("--feat", type=int, default=1,
+                    help="feat-axis size of the traced mesh (--by-axis)")
     ap.add_argument("--wires", type=str, default="native,bf16,int8,fp8")
     ap.add_argument("--parts", type=int, default=8)
     ap.add_argument("--scale", type=float, default=0.05)
@@ -178,14 +181,16 @@ def main():
     if args.by_axis:
         events, path = load_trace_events(args.by_axis)
         print(f"trace: {path}")
-        table = comm_by_axis(events, args.parts, args.replicas)
+        table = comm_by_axis(events, args.parts, args.replicas, args.feat)
         if not table:
             print("no device collective events in the trace")
             return 1
-        print(f"\ncollective device time by mesh axis "
-              f"(mesh {args.replicas} x {args.parts} replicas x parts):"
-              if args.replicas > 1 else
-              f"\ncollective device time by mesh axis ({args.parts} parts):")
+        if args.replicas > 1 or args.feat > 1:
+            desc = (f"mesh {args.replicas} x {args.parts} x {args.feat} "
+                    f"replicas x parts x feat")
+        else:
+            desc = f"{args.parts} parts"
+        print(f"\ncollective device time by mesh axis ({desc}):")
         print("| axis | exchange (s) | reduce (s) |")
         print("|---|---|---|")
         for axis in sorted(table):
