@@ -77,6 +77,35 @@ _SEED_BEST = {
 }
 
 
+# ---------------------------------------------------------------------------
+# online-serving metric vocabulary (tools/serve_bench.py emits these; the
+# driver captures them into BENCH_*.json exactly like the epoch-time lines).
+# Names are load-bearing: a rename silently orphans every recorded BENCH
+# file, so serve_bench imports THIS table instead of spelling its own.
+# ---------------------------------------------------------------------------
+
+SERVE_METRICS = {
+    "serve_p50_ms": "ms",          # per-request latency median, per tier
+    "serve_p99_ms": "ms",          # per-request latency 99th pct, per tier
+    "serve_qps": "req/s/chip",     # sustained throughput per accelerator chip
+}
+
+
+def emit_serve_metric(name: str, value: float, tier: str | None = None,
+                      **extra):
+    """One driver-parsed JSON metric line for the serving bench (same
+    last-line-wins contract as the epoch-time emitter above)."""
+    if name not in SERVE_METRICS:
+        raise ValueError(f"unknown serve metric {name!r} "
+                         f"(vocabulary: {sorted(SERVE_METRICS)})")
+    line = {"metric": name, "value": round(float(value), 4),
+            "unit": SERVE_METRICS[name]}
+    if tier is not None:
+        line["tier"] = tier
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
 def _workload_tag(args) -> str:
     tag = f"{args.graph}_{args.scale:g}_{args.avg_degree}"
     # non-flagship models get their own best_known/anchor namespace (a GAT

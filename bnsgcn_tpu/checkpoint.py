@@ -45,18 +45,13 @@ def _to_host(tree):
     return serialization.to_state_dict(host)
 
 
-def save_checkpoint(path: str, *, params, opt_state=None, bn_state=None,
-                    epoch: int = 0, best_acc: float = 0.0, seed: int = 0,
-                    extra: Optional[dict] = None):
-    payload = {
-        "params": _to_host(params),
-        "opt_state": _to_host(opt_state) if opt_state is not None else {},
-        "bn_state": _to_host(bn_state) if bn_state is not None else {},
-        "epoch": epoch,
-        "best_acc": float(best_acc),
-        "seed": seed,
-        "extra": extra or {},
-    }
+def write_blob(path: str, payload: dict):
+    """Atomically write `payload` (a msgpack-able pytree of numpy arrays and
+    scalars) under the checkpoint integrity header: magic + sha256(payload),
+    fsync'd before the atomic rename, the containing dir fsync'd after.
+    Shared by the training checkpoints below and the embedding-table
+    artifacts (`--dump-embeddings`, serve.py) so every durable artifact in
+    the repo carries the same torn-write protection."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     blob = serialization.msgpack_serialize(payload)
     tmp = path + ".tmp"
@@ -80,11 +75,10 @@ def save_checkpoint(path: str, *, params, opt_state=None, bn_state=None,
         pass                        # not supported on every filesystem
 
 
-def load_checkpoint(path: str) -> dict[str, Any]:
-    """Read + verify a checkpoint. Raises CheckpointCorrupt on a zero-byte,
-    torn, or checksum-failing file (callers that walk the chain catch it;
-    `latest_valid_checkpoint` is the crash-proof entry). Files without the
-    magic header are pre-checksum checkpoints and load unverified."""
+def read_blob(path: str) -> dict[str, Any]:
+    """Read + verify an integrity-headed blob. Raises CheckpointCorrupt on a
+    zero-byte, torn, or checksum-failing file. Files without the magic
+    header are pre-checksum checkpoints and load unverified."""
     with open(path, "rb") as f:
         raw = f.read()
     if not raw:
@@ -104,6 +98,27 @@ def load_checkpoint(path: str) -> dict[str, Any]:
     except Exception as ex:
         raise CheckpointCorrupt(
             f"{path}: undecodable payload ({type(ex).__name__}: {ex})") from ex
+
+
+def save_checkpoint(path: str, *, params, opt_state=None, bn_state=None,
+                    epoch: int = 0, best_acc: float = 0.0, seed: int = 0,
+                    extra: Optional[dict] = None):
+    write_blob(path, {
+        "params": _to_host(params),
+        "opt_state": _to_host(opt_state) if opt_state is not None else {},
+        "bn_state": _to_host(bn_state) if bn_state is not None else {},
+        "epoch": epoch,
+        "best_acc": float(best_acc),
+        "seed": seed,
+        "extra": extra or {},
+    })
+
+
+def load_checkpoint(path: str) -> dict[str, Any]:
+    """Read + verify a checkpoint. Raises CheckpointCorrupt on a zero-byte,
+    torn, or checksum-failing file (callers that walk the chain catch it;
+    `latest_valid_checkpoint` is the crash-proof entry)."""
+    return read_blob(path)
 
 
 def load_or_error(path: str) -> tuple[Optional[dict], Optional[str]]:
@@ -211,3 +226,41 @@ def latest_valid_checkpoint(cfg, log=None, before_epoch: Optional[int] = None
                 log(f"[resilience] skipping unreadable checkpoint "
                     f"{fn}: {ex}")
     return None
+
+
+def final_best_payload(cfg, best_acc: float, log) -> Optional[dict]:
+    """The best-params recovery contract, shared by every resume path in
+    run.py (single-host, uncoordinated multi-host, coordinated) AND the
+    serving loader: the final checkpoint must load AND carry the resumed
+    best_acc (within 1e-9) or it belongs to another run — the caller then
+    restarts best tracking instead of adopting foreign params. Returns the
+    validated payload (reused for restore_into — one read+checksum total)
+    or None."""
+    fpath = final_path(cfg)
+    payload, err = load_or_error(fpath)
+    if payload is None:
+        if err and os.path.exists(fpath):
+            log(f"[resilience] final checkpoint unusable ({err}); "
+                f"restarting best tracking")
+        return None
+    if abs(float(payload.get("best_acc", -1.0)) - best_acc) >= 1e-9:
+        return None
+    return payload
+
+
+def serving_checkpoint(cfg, log=None) -> Optional[tuple[str, dict]]:
+    """(path, payload) of the checkpoint an inference server should load:
+    the final (best-validation) checkpoint when it verifies, else the newest
+    valid periodic checkpoint. The ONE selection entry point shared with the
+    resume flow — both route through `load_or_error` +
+    `latest_valid_checkpoint`, so serve can never load a torn file: a
+    corrupt final model costs a log line and a fallback, not a crash or a
+    silently-wrong model."""
+    fpath = final_path(cfg)
+    payload, err = load_or_error(fpath)
+    if payload is not None:
+        return fpath, payload
+    if err and log and os.path.exists(fpath):
+        log(f"[serve] final checkpoint unusable ({err}); walking the "
+            f"periodic chain")
+    return latest_valid_checkpoint(cfg, log=log)

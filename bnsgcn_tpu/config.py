@@ -177,6 +177,32 @@ class Config:
                                         # only through the coordinator)
     coord_world: int = 0                # total coordination ranks; 0 =
                                         # jax.process_count()
+    # --- online inference serving (serve.py; `python -m bnsgcn_tpu.main
+    # serve ...` or `python -m bnsgcn_tpu.serve ...`) ---
+    serve_port: int = 18120             # line-JSON TCP port the node-
+                                        # prediction server listens on
+                                        # (same wire protocol/framing as the
+                                        # rank coordinator's KV server)
+    serve_addr: str = ""                # bind address (server) / connect
+                                        # address (clients); default all
+                                        # interfaces / 127.0.0.1
+    serve_dir: str = ""                 # serving state dir (resumable delta
+                                        # log flushed on SIGTERM drain);
+                                        # default {ckpt_path}/serve
+    serve_max_batch: int = 64           # max tier-B requests coalesced into
+                                        # one padded-SpMM bucket step
+    serve_refresh_s: float = 0.2        # background dirty-embedding refresh
+                                        # cadence (0 = refresh only on
+                                        # demand / 'flush')
+    embeddings: str = ""                # embedding-table artifact
+                                        # (--dump-embeddings output) to
+                                        # cold-start serving from instead of
+                                        # recomputing the all-node table
+    dump_embeddings: str = ""           # eval path: write the all-node
+                                        # embedding table (penultimate
+                                        # activations + final-layer logits,
+                                        # checkpoint integrity header) here
+
     cache_dir: str = ""                 # persistent dir for SpMM layout pickles
                                         # (content-addressed by hybrid_layout_key);
                                         # default from $BNSGCN_CACHE_DIR — point it at
@@ -301,6 +327,18 @@ def create_parser() -> argparse.ArgumentParser:
               "coordinator without jax.distributed — the subprocess fault "
               "harness)")
     both("coord-world", type=int, default=0)
+    # online inference serving (serve.py)
+    both("serve-port", type=int, default=18120)
+    both("serve-addr", type=str, default="")
+    both("serve-dir", type=str, default="")
+    both("serve-max-batch", type=int, default=64)
+    both("serve-refresh-s", type=float, default=0.2)
+    p.add_argument("--embeddings", type=str, default="",
+                   help="embedding-table artifact (--dump-embeddings "
+                        "output) to cold-start serving from")
+    both("dump-embeddings", type=str, default="",
+         help="write the all-node embedding table (+ integrity header) "
+              "here after eval — serve.py cold-starts from it")
     both("cache-dir", type=str,
          default=os.environ.get("BNSGCN_CACHE_DIR", ""))
     both("edge-chunk", type=int, default=0)

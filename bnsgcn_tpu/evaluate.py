@@ -56,6 +56,21 @@ def full_graph_logits(params, state, spec: ModelSpec, g: Graph,
     return np.asarray(jax.device_get(logits))
 
 
+def full_graph_embeddings(params, state, spec: ModelSpec, g: Graph,
+                          edge_chunk: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """(hidden [N, H], logits [N, C]): the all-node embedding table the
+    serving subsystem (serve.py) and `--dump-embeddings` precompute — the
+    penultimate activations (final layer's input) plus the final-layer
+    scores, through the SAME eval forward as `full_graph_logits`, so served
+    tier-A scores are bitwise the full-eval logits."""
+    env = build_eval_env(g, spec, edge_chunk)
+    feat = jnp.asarray(g.feat)
+    logits, _, hidden = apply_model(params, state, spec, feat, env,
+                                    return_hidden=True)
+    return (np.asarray(jax.device_get(hidden)),
+            np.asarray(jax.device_get(logits)))
+
+
 def evaluate_trans(name: str, params, state, spec: ModelSpec, g: Graph,
                    result_file: Optional[str] = None,
                    edge_chunk: int = 0) -> tuple[float, float]:

@@ -8,6 +8,10 @@ every local device, and multi-host pods use `jax.distributed.initialize`
   python -m bnsgcn_tpu.main --dataset reddit --n-partitions 8 \
       --model graphsage --n-layers 4 --n-hidden 256 --sampling-rate 0.1 \
       --use-pp --inductive
+
+Subcommands: `python -m bnsgcn_tpu.main serve ...` starts the online
+inference server (serve.py) against a trained checkpoint — two-tier node
+prediction with delta ingestion; exits 75 on a graceful SIGTERM drain.
 """
 
 from __future__ import annotations
@@ -24,6 +28,13 @@ from bnsgcn_tpu.run import prepare_partition, run_training
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # online inference serving rides the same flag vocabulary but a
+        # different lifecycle (long-running server, drain-on-SIGTERM) —
+        # dispatch before the training config/seed handling below
+        from bnsgcn_tpu import serve
+        return serve.serve_main(argv[1:])
     cfg = parse_config(argv)
     if not cfg.fix_seed:
         # reference randomizes the seed unless --fix-seed (main.py:13-16)

@@ -418,20 +418,29 @@ def _gat_layer(p, h_dst, h_ext, presence, env: GraphEnv, heads, out_feats,
 # full forward
 # ----------------------------------------------------------------------------
 
-def apply_model(params, state, spec: ModelSpec, feat, env: GraphEnv):
+def apply_model(params, state, spec: ModelSpec, feat, env: GraphEnv,
+                return_hidden: bool = False):
     """Forward pass. Returns (logits [n_dst, n_class], new_state).
 
     In training mode `feat` is the (possibly precomputed) per-partition inner
     feature block; in eval mode it is the raw full-graph features and
     `env.exchange` is the identity.
+
+    `return_hidden=True` additionally returns the penultimate activations
+    (the final layer's input, post norm/relu) as a third element — the
+    embedding-table export seam the serving subsystem (serve.py,
+    `--dump-embeddings`) precomputes from. Default calls are unchanged.
     """
     h = feat
+    hidden = None
     new_state = dict(state)
     rngs = [None] * spec.n_layers
     if env.training and env.rng is not None:
         rngs = list(jax.random.split(env.rng, spec.n_layers))
 
     for i in range(spec.n_layers):
+        if i == spec.n_layers - 1:
+            hidden = h
         body = partial(_layer_forward, i=i, params=params, state=state,
                        spec=spec, env=env, rng=rngs[i])
         if env.remat and env.training:
@@ -444,6 +453,8 @@ def apply_model(params, state, spec: ModelSpec, feat, env: GraphEnv):
         if st_i is not None:
             new_state[f"norm_{i}"] = st_i
 
+    if return_hidden:
+        return h, new_state, hidden
     return h, new_state
 
 

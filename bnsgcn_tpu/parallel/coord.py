@@ -53,6 +53,7 @@ __all__ = [
     "CoordError", "CoordTimeout", "CoordAbort", "Coordinator",
     "TcpTransport", "FileTransport", "make_coordinator",
     "STATE_PRIORITY", "reduce_states",
+    "LineJsonServer", "rpc_line_json",
 ]
 
 
@@ -151,7 +152,7 @@ class _KVStore:
                     if k.startswith(prefix)}
 
 
-class _KVRequestHandler(socketserver.StreamRequestHandler):
+class _LineJsonHandler(socketserver.StreamRequestHandler):
     timeout = 10.0
 
     def handle(self):
@@ -160,31 +161,113 @@ class _KVRequestHandler(socketserver.StreamRequestHandler):
             if not line:
                 return
             req = json.loads(line)
-            store: _KVStore = self.server.store           # type: ignore[attr-defined]
-            op = req.get("op")
-            if op == "put":
-                store.put(req["k"], req["v"])
-                resp = {"ok": True}
-            elif op == "get":
-                v = store.get(req["k"])
-                resp = {"ok": v is not None, "v": v}
-            elif op == "del":
-                store.delete(req["k"])
-                resp = {"ok": True}
-            elif op == "dump":
-                resp = {"ok": True, "items": store.dump(req.get("p", ""))}
-            elif op == "ping":
-                resp = {"ok": True}
-            else:
-                resp = {"ok": False, "err": f"unknown op {op!r}"}
+            try:
+                resp = self.server.handle_fn(req)         # type: ignore[attr-defined]
+            except Exception as ex:                       # noqa: BLE001
+                # a handler bug answers the one request with an error —
+                # it never takes the server (or its siblings) down
+                resp = {"ok": False, "err": f"{type(ex).__name__}: {ex}"}
             self.wfile.write(json.dumps(resp).encode() + b"\n")
         except (OSError, ValueError, KeyError):
             pass        # a torn request never takes the server down
 
 
-class _KVServer(socketserver.ThreadingTCPServer):
+class LineJsonServer(socketserver.ThreadingTCPServer):
+    """Threaded one-line-JSON-per-connection TCP server: each request is a
+    single JSON line, dispatched to `handle_fn(dict) -> dict`, answered with
+    one JSON line. The transport layer both the rank coordinator (KV verdict
+    store, below) and the online inference server (serve.py) run on — one
+    wire protocol, one framing implementation."""
+
     allow_reuse_address = True
     daemon_threads = True
+
+    def __init__(self, port: int, handle_fn: Callable[[dict], dict],
+                 addr: str = ""):
+        super().__init__((addr, port), _LineJsonHandler)
+        self.handle_fn = handle_fn
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="bnsgcn-linejson-server",
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()
+
+
+def rpc_line_json(addr: str, port: int, req: dict, deadline: float,
+                  what: str = "coordinator", retry_sent: bool = True) -> dict:
+    """One request/response round trip against a LineJsonServer, retried
+    with backoff until `deadline` (connect refusals during peer startup are
+    expected — retrying makes client/server start order free).
+
+    `retry_sent=False` never re-sends a request the server may already have
+    received: once the payload went out, a torn/slow response raises
+    instead of retrying, and the per-attempt read timeout stretches to the
+    full remaining deadline. The KV coordinator's ops are idempotent so it
+    keeps the resilient default; serve clients (add_edges, flush) are NOT —
+    a silent re-send would ingest a delta twice or start a second flush."""
+    delay = 0.05
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise CoordTimeout(
+                f"{what} at {addr}:{port} unreachable "
+                f"(op {req.get('op')!r} key {req.get('k', '')!r})")
+        sent = False
+        try:
+            with socket.create_connection(
+                    (addr, port),
+                    timeout=min(max(remaining, 0.05), 5.0)) as s:
+                s.settimeout(max(remaining, 0.05) if not retry_sent
+                             else min(max(remaining, 0.05), 10.0))
+                s.sendall(json.dumps(req).encode() + b"\n")
+                sent = True
+                line = s.makefile("rb").readline(1 << 20)
+            if line:
+                return json.loads(line)
+        except (OSError, ValueError) as ex:
+            if sent and not retry_sent:
+                raise CoordTimeout(
+                    f"{what} at {addr}:{port} accepted op "
+                    f"{req.get('op')!r} but the response was lost "
+                    f"({type(ex).__name__}: {ex}); not re-sending a "
+                    f"non-idempotent request — check server state before "
+                    f"retrying") from ex
+        if sent and not retry_sent:
+            # connection closed with no response line: same at-most-once rule
+            raise CoordTimeout(
+                f"{what} at {addr}:{port} closed the connection after op "
+                f"{req.get('op')!r} was sent; not re-sending a "
+                f"non-idempotent request")
+        time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
+        delay = min(delay * 2, 1.0)
+
+
+def _kv_handle(store: _KVStore, req: dict) -> dict:
+    op = req.get("op")
+    if op == "put":
+        store.put(req["k"], req["v"])
+        return {"ok": True}
+    if op == "get":
+        v = store.get(req["k"])
+        return {"ok": v is not None, "v": v}
+    if op == "del":
+        store.delete(req["k"])
+        return {"ok": True}
+    if op == "dump":
+        return {"ok": True, "items": store.dump(req.get("p", ""))}
+    if op == "ping":
+        return {"ok": True}
+    return {"ok": False, "err": f"unknown op {op!r}"}
 
 
 class TcpTransport:
@@ -196,34 +279,13 @@ class TcpTransport:
         self.addr, self.port = addr, port
         self._server = None
         if serve:
-            self._server = _KVServer(("", port), _KVRequestHandler)
-            self._server.store = _KVStore()               # type: ignore[attr-defined]
-            t = threading.Thread(target=self._server.serve_forever,
-                                 name="bnsgcn-coord-server", daemon=True)
-            t.start()
+            store = _KVStore()
+            self._server = LineJsonServer(
+                port, lambda req: _kv_handle(store, req)).start()
 
     # -- one request/response round trip, retried until `deadline` --
     def _rpc(self, req: dict, deadline: float) -> dict:
-        delay = 0.05
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise CoordTimeout(
-                    f"coordinator at {self.addr}:{self.port} unreachable "
-                    f"(op {req.get('op')!r} key {req.get('k', '')!r})")
-            try:
-                with socket.create_connection(
-                        (self.addr, self.port),
-                        timeout=min(max(remaining, 0.05), 5.0)) as s:
-                    s.settimeout(min(max(remaining, 0.05), 10.0))
-                    s.sendall(json.dumps(req).encode() + b"\n")
-                    line = s.makefile("rb").readline(1 << 20)
-                if line:
-                    return json.loads(line)
-            except (OSError, ValueError):
-                pass
-            time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
-            delay = min(delay * 2, 1.0)
+        return rpc_line_json(self.addr, self.port, req, deadline)
 
     def put(self, key: str, value: str, deadline: float):
         self._rpc({"op": "put", "k": key, "v": value}, deadline)
@@ -241,8 +303,7 @@ class TcpTransport:
 
     def close(self):
         if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
+            self._server.stop()
             self._server = None
 
 

@@ -108,6 +108,63 @@ class CheckpointUnavailable(Exception):
 
 
 # ----------------------------------------------------------------------------
+# preemption signals — the PR-4 SIGTERM/SIGINT contract, reusable
+# ----------------------------------------------------------------------------
+
+class PreemptSignals:
+    """SIGTERM/SIGINT -> a flag the owner polls at its own safe boundary;
+    a SECOND signal restores default handling and re-raises (the operator,
+    or the platform's kill escalation, wants out NOW). Extracted from
+    ResilienceManager so the online inference server (serve.py) drains with
+    the exact same handler semantics the training loop checkpoints with.
+
+    `action` is the one-line promise printed on the first signal — what the
+    owner will do at its `boundary` before exiting EXIT_PREEMPTED."""
+
+    def __init__(self, action: str = "checkpoint",
+                 boundary: str = "step boundary"):
+        self.action = action
+        self.boundary = boundary
+        self._requested: Optional[str] = None
+        self._old_handlers: dict = {}
+
+    def install(self):
+        """Main thread only — a worker-thread owner just skips them."""
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    self._old_handlers[sig] = signal.signal(sig, self._on_signal)
+                except (ValueError, OSError):
+                    pass
+        return self
+
+    def restore(self):
+        for sig, old in self._old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old_handlers.clear()
+
+    def _on_signal(self, signum, frame):
+        name = signal.Signals(signum).name
+        if self._requested is not None:
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        self._requested = name
+        # async-signal-safe enough: one line, flushed by the owner's boundary
+        sys.stderr.write(
+            f"\n[resilience] {name} received: will {self.action} and exit "
+            f"{EXIT_PREEMPTED} at the next {self.boundary} (send again to "
+            f"kill immediately)\n")
+
+    @property
+    def requested(self) -> Optional[str]:
+        return self._requested
+
+
+# ----------------------------------------------------------------------------
 # fault-injection plan
 # ----------------------------------------------------------------------------
 
@@ -311,8 +368,7 @@ class ResilienceManager:
         self.backoff_base = float(os.environ.get("BNSGCN_RETRY_BACKOFF_S", 1.0))
         self.backoff_cap = 30.0
         self.rollbacks: list[dict] = []     # surfaced on RunResult
-        self._preempt: Optional[str] = None
-        self._old_handlers: dict = {}
+        self._signals = PreemptSignals(action="checkpoint")
         self._snapshot = None
         self._pending_payload = None    # rank 0: the checkpoint payload
                                         # plan_rollback just validated, so
@@ -324,45 +380,20 @@ class ResilienceManager:
     def start(self):
         """Install signal handlers (main thread only — a worker-thread
         run_training just skips them) and start the watchdog."""
-        if threading.current_thread() is threading.main_thread():
-            for sig in (signal.SIGTERM, signal.SIGINT):
-                try:
-                    self._old_handlers[sig] = signal.signal(sig, self._on_signal)
-                except (ValueError, OSError):
-                    pass
+        self._signals.install()
         self.watchdog.start()
         return self
 
     def close(self):
         self.watchdog.stop()
         self.watchdog.join(timeout=2.0)
-        for sig, old in self._old_handlers.items():
-            try:
-                signal.signal(sig, old)
-            except (ValueError, OSError):
-                pass
-        self._old_handlers.clear()
+        self._signals.restore()
 
     # -- preemption --
 
-    def _on_signal(self, signum, frame):
-        name = signal.Signals(signum).name
-        if self._preempt is not None:
-            # second signal: the operator (or the platform's kill escalation)
-            # wants out NOW — restore default handling and re-raise
-            signal.signal(signum, signal.SIG_DFL)
-            signal.raise_signal(signum)
-            return
-        self._preempt = name
-        # async-signal-safe enough: one line, flushed by the step boundary log
-        sys.stderr.write(
-            f"\n[resilience] {name} received: will checkpoint and exit "
-            f"{EXIT_PREEMPTED} at the next step boundary (send again to "
-            f"kill immediately)\n")
-
     @property
     def preempt_requested(self) -> Optional[str]:
-        return self._preempt
+        return self._signals.requested
 
     # -- divergence rollback --
 

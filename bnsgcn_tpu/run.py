@@ -87,23 +87,9 @@ def prepare_partition(cfg: Config, g: Optional[Graph] = None,
     return art
 
 
-def _final_best_payload(cfg: Config, best_acc: float, log):
-    """The best-params recovery contract, shared by every resume path
-    (single-host, uncoordinated multi-host, coordinated): the final
-    checkpoint must load AND carry the resumed best_acc (within 1e-9) or
-    it belongs to another run — the caller then restarts best tracking
-    instead of adopting foreign params. Returns the validated payload
-    (reused for restore_into — one read+checksum total) or None."""
-    fpath = ckpt.final_path(cfg)
-    payload, err = ckpt.load_or_error(fpath)
-    if payload is None:
-        if err and os.path.exists(fpath):
-            log(f"[resilience] final checkpoint unusable ({err}); "
-                f"restarting best tracking")
-        return None
-    if abs(float(payload.get("best_acc", -1.0)) - best_acc) >= 1e-9:
-        return None
-    return payload
+# best-params recovery contract: consolidated in checkpoint.py (PR 7) so the
+# serving loader shares the exact same selection/validation entry points
+_final_best_payload = ckpt.final_best_payload
 
 
 def check_mesh_budget(cfg: Config, devices=None) -> None:
@@ -1128,4 +1114,47 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             res.test_acc = evaluate_induc("Test Result", best_params,
                                           jax.device_get(state), spec, test_g,
                                           "test")
+
+    # ---- embedding-table export (--dump-embeddings): the all-node
+    # penultimate activations + final-layer logits, written under the
+    # checkpoint integrity header so serve.py can cold-start from the
+    # artifact instead of recomputing. Uses the best-val params when
+    # available (what serving should score with), else the final params —
+    # so `--resume --n-epochs 0 --dump-embeddings PATH` is a standalone
+    # embedding-export tool over a finished run. ----
+    if cfg.dump_embeddings and is_rank0:
+        from bnsgcn_tpu import serve as serve_mod
+        from bnsgcn_tpu.evaluate import full_graph_embeddings, gather_parts
+        dump_params = (best_params if best_params is not None
+                       else jax.device_get(params))
+        t0 = time.time()
+        hidden = logits = None
+        if multi_host:
+            log("[serve] --dump-embeddings skipped: multi-host export needs "
+                "a gather of remote part rows (single-host only for now)")
+        elif mesh_eval and not cfg.inductive:
+            # mesh seam: the eval forward returning (hidden, logits) per
+            # part (trainer.embed_forward), assembled to global node order
+            fns_e, blk_e, tf_e, art_e = eval_val
+            hid, lg = fns_e.embed_forward(place_p(dump_params), state,
+                                          blk_e, tf_e)
+            hidden = gather_parts(art_e, hid)
+            logits = gather_parts(art_e, lg)
+        elif test_g is not None or g is not None:
+            graph = test_g if test_g is not None else g
+            hidden, logits = full_graph_embeddings(
+                dump_params, jax.device_get(state), spec, graph,
+                cfg.edge_chunk)
+        else:
+            log("[serve] --dump-embeddings skipped: no eval graph loaded "
+                "(run with --eval, or --eval-device mesh transductive)")
+        if hidden is not None:
+            serve_mod.save_table(cfg.dump_embeddings, hidden, logits, meta={
+                "graph_name": cfg.graph_name or cfg.derive_graph_name(),
+                "model": cfg.model, "n_nodes": int(hidden.shape[0]),
+                "epoch": cfg.n_epochs - 1,
+                "best_acc": float(best_acc)})
+            log(f"[serve] embedding table [{hidden.shape[0]} x "
+                f"{hidden.shape[1]}] + logits [{logits.shape[1]} classes] "
+                f"-> {cfg.dump_embeddings} ({time.time() - t0:.1f}s)")
     return res

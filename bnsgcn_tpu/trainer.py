@@ -150,6 +150,11 @@ class StepFns:
     extra_blk: dict           # extra per-part arrays (ELL layouts) to merge into the block dict
     drop_blk_keys: tuple      # block keys the compiled step does not read (drop to save HBM)
     eval_forward: Callable = None  # mesh-distributed eval-mode forward (full rate)
+    embed_forward: Callable = None  # mesh-distributed embedding export: the
+                              # eval forward returning (hidden, logits) per
+                              # part — hidden is the final layer's input, the
+                              # all-node embedding table serve.py and
+                              # --dump-embeddings assemble via gather_parts
     overlap: str = "off"      # RESOLVED --overlap mode ('split' only when the
                               # train step really runs the interior/frontier
                               # split; run.py labels the header from this)
@@ -733,12 +738,14 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         out = f(params, state, blk, tables, epoch, sample_key, drop_key)
         return dedup_replica0(out, mesh, hspec.n_parts)
 
-    def local_eval(params, state, blk, tables_full):
-        """Mesh-distributed full-rate eval forward (capability upgrade over
-        the reference's single-process CPU eval, train.py:313-319,427-441).
-        Eval-path semantics: no dropout, all halos present, BN running stats;
-        the caller supplies eval-graph artifacts so norms are the eval
-        graph's own degrees (module/layer.py:39-45,93-102)."""
+    def local_embed(params, state, blk, tables_full):
+        """Mesh-distributed full-rate eval forward returning (hidden,
+        logits) — hidden is the final layer's input, the embedding-export
+        seam (--dump-embeddings / serve cold-start). Eval-path semantics:
+        no dropout, all halos present, BN running stats; the caller
+        supplies eval-graph artifacts so norms are the eval graph's own
+        degrees (module/layer.py:39-45,93-102). local_eval below is its
+        logits half, so the two can never drift."""
         blk = {k: v[0] for k, v in blk.items()}
         zero = jnp.zeros((), jnp.uint32)
         plan = make_halo_plan(hspec_full, tables_full, blk["bnd"], zero,
@@ -747,8 +754,14 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                          False, aggregate=_aggregate_for(blk),
                          gat_ell=_gat_ell_for(blk),
                          n_replicas=n_rep, feat_axis=fe_axis, n_feat=n_fe)
-        logits, _ = apply_model(params, state, spec, blk["feat"], env)
-        return logits[None]
+        logits, _, hidden = apply_model(params, state, spec, blk["feat"],
+                                        env, return_hidden=True)
+        return hidden[None], logits[None]
+
+    def local_eval(params, state, blk, tables_full):
+        # the eval forward IS local_embed's logits output (XLA dead-code-
+        # eliminates the unused hidden half under jit)
+        return local_embed(params, state, blk, tables_full)[1]
 
     @jax.jit
     def eval_forward(params, state, blk, tables_full):
@@ -759,6 +772,15 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                           out_specs=stacked)
         return dedup_replica0(f(params, state, blk, tables_full),
                               mesh, hspec.n_parts)
+
+    @jax.jit
+    def embed_forward(params, state, blk, tables_full):
+        f = shard_map(local_embed, mesh=mesh,
+                          in_specs=(param_spec, rep, blk_spec, rep),
+                          out_specs=(stacked, stacked))
+        hid, lg = f(params, state, blk, tables_full)
+        return (dedup_replica0(hid, mesh, hspec.n_parts),
+                dedup_replica0(lg, mesh, hspec.n_parts))
 
     def local_precompute(blk, tables_full):
         blk = {k: v[0] for k, v in blk.items()}
@@ -811,6 +833,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                   precompute=precompute, exchange_only=jax.jit(
                       exchange_only, static_argnames="width"),
                   eval_forward=eval_forward,
+                  embed_forward=embed_forward,
                   extra_blk=ell_arrays,
                   drop_blk_keys=(("src", "dst")
                                  if (ell_spmm is not None or gat_spec is not None)

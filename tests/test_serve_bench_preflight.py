@@ -1,0 +1,58 @@
+"""CPU preflight of the serving load generator (tools/serve_bench.py).
+
+Mirror of tests/test_bench_preflight.py for the serving bench: the ACTUAL
+tool runs as a subprocess at tiny scale on CPU and must emit every metric
+in bench.py's SERVE_METRICS vocabulary, for both tiers, as parseable JSON
+lines — a serve-bench invocation that cannot produce its metrics here would
+waste a hardware window (and the driver would record an empty BENCH entry).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from bench import SERVE_METRICS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    # the suite conftest forces an 8-device CPU mesh; the serving bench
+    # needs no mesh — drop the forced device count for the subprocess
+    flags = env.get("XLA_FLAGS", "").replace(
+        "--xla_force_host_platform_device_count=8", "").strip()
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    return env
+
+
+@pytest.mark.slow
+def test_serve_bench_emits_full_metric_vocabulary():
+    cmd = [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+           "--requests", "40", "--concurrency", "2", "--warmup", "4",
+           "--hidden", "8", "--json-only"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                       cwd=REPO, env=_env())
+    tail = "\n".join((r.stdout + "\n" + r.stderr).splitlines()[-25:])
+    assert r.returncode == 0, f"serve_bench failed preflight:\n{tail}"
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON metric lines:\n{tail}"
+    seen = {(ln["metric"], ln.get("tier")) for ln in lines}
+    for metric in SERVE_METRICS:
+        for tier in ("A", "B"):
+            assert (metric, tier) in seen, f"missing {metric}/{tier}:\n{tail}"
+    for ln in lines:
+        assert ln["metric"] in SERVE_METRICS, f"off-vocabulary: {ln}"
+        assert ln["unit"] == SERVE_METRICS[ln["metric"]]
+        assert ln["value"] > 0, f"non-positive metric: {ln}"
+    # last line wins for the driver: it must be a valid vocabulary metric
+    last = lines[-1]
+    assert last["metric"] == "serve_qps" and last["tier"] == "A"
