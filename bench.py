@@ -538,6 +538,14 @@ def main():
                          "only exist on a TPU backend without --no-pallas; "
                          "an all-unknown list is an error (exit 2), never a "
                          "silent anchor-only run")
+    ap.add_argument("--obs-log", type=str,
+                    default=os.environ.get("BNSGCN_OBS_LOG", ""),
+                    help="obs telemetry JSONL (bnsgcn_tpu/obs.py): the "
+                         "worker records a bench header + one bench_variant "
+                         "event per gated measurement, and every result "
+                         "JSON carries the log's path — hardware-window "
+                         "runs become post-hoc auditable with "
+                         "tools/obs_report.py --compare")
     ap.add_argument("--probe-timeout-s", type=float, default=150.0,
                     help="supervisor: per-probe subprocess timeout (a "
                          "wedged tunnel HANGS jax.devices() forever)")
@@ -967,6 +975,23 @@ def main():
         log(f"prep-only done: {sorted(layout_cache)}")
         return
 
+    # obs telemetry (bnsgcn_tpu/obs.py): one bench_header + one
+    # bench_variant event per gated measurement — the trajectory record
+    # tools/obs_report.py --compare diffs across hardware windows
+    obs_ev = None
+    # the audit pointer every result JSON carries — ONE definition so the
+    # per-variant history and both RESULT lines can never disagree
+    obs_extra = ({"obs_log": os.path.abspath(args.obs_log)}
+                 if args.obs_log else {})
+    if args.obs_log:
+        from bnsgcn_tpu.obs import EventLog
+        obs_ev = EventLog(args.obs_log)
+        obs_ev.emit("bench_header", workload=_workload_tag(args),
+                    model=args.model, epochs=args.epochs,
+                    hidden=args.hidden, layers=args.layers,
+                    dtype=args.dtype, graph=args.graph,
+                    candidates=[_vname(v) for v in candidates])
+
     for variant in candidates:
         name = _vname(variant)
         if best is not None and time.time() - t_start > args.budget_s:
@@ -1034,6 +1059,11 @@ def main():
             # gate its quantized twins are judged against
             native_l0[base], native_lf[base] = l0, lf
         log(f"  spmm={name}: {et:.4f}s/epoch loss={lf:.4f}")
+        if obs_ev is not None:
+            obs_ev.emit("bench_variant", name=name, epoch_s=round(et, 4),
+                        min_epoch_s=round(mt, 4), loss=round(lf, 4),
+                        backend=jax.default_backend(),
+                        profiled=bool(args.profile_dir))
         try:
             # structured per-candidate history (append-only) — the winner
             # JSON line only carries the best, but cross-window analysis
@@ -1046,7 +1076,10 @@ def main():
                     "epoch_s": round(et, 4), "min_epoch_s": round(mt, 4),
                     "loss": round(lf, 4),
                     "backend": jax.default_backend(),
-                    "profiled": bool(args.profile_dir)}) + "\n")
+                    "profiled": bool(args.profile_dir),
+                    # the obs-log path makes this measurement post-hoc
+                    # auditable: obs_report --compare two windows' logs
+                    **obs_extra}) + "\n")
         except Exception:
             pass
         if best is None or et < best[0]:
@@ -1068,6 +1101,7 @@ def main():
                 "value": round(et, 4), "unit": "s/epoch",
                 **({"vs_baseline": round(BASELINE_EPOCH_S / et, 3)}
                    if args.model == "graphsage" else {}),
+                **obs_extra,
             }), flush=True)
         del built
     if best is None and args.skip_anchor and ref_loss is not None:
@@ -1096,7 +1130,12 @@ def main():
         "unit": "s/epoch",
         **({"vs_baseline": round(BASELINE_EPOCH_S / epoch_t, 3)}
            if args.model == "graphsage" else {}),
+        **obs_extra,
     }))
+    if obs_ev is not None:
+        obs_ev.emit("bench_end", winner=spmm_used,
+                    epoch_s=round(epoch_t, 4), min_epoch_s=round(min_t, 4))
+        obs_ev.close()
 
 
 if __name__ == "__main__":

@@ -203,6 +203,22 @@ class Config:
                                         # activations + final-layer logits,
                                         # checkpoint integrity header) here
 
+    # --- observability (obs.py: unified telemetry bus) ---
+    obs: str = "on"                     # 'on' (process-wide metrics registry +
+                                        # structured event log + post-mortem
+                                        # capture, obs.py) | 'off' (constructs
+                                        # none of it: bit-identical loop,
+                                        # pinned by tests/test_obs.py)
+    obs_log: str = ""                   # rank-tagged JSONL event log path
+                                        # (default $BNSGCN_OBS_LOG; ranks > 0
+                                        # write PATH.r<rank>); size-bounded
+                                        # with rotation ($BNSGCN_OBS_MAX_MB).
+                                        # Empty = registry only, no file
+    obs_dir: str = ""                   # post-mortem dir (watchdog/divergence
+                                        # dumps, SIGUSR1 stack+metrics+trace
+                                        # snapshots); default
+                                        # {ckpt_path}/postmortem
+
     cache_dir: str = ""                 # persistent dir for SpMM layout pickles
                                         # (content-addressed by hybrid_layout_key);
                                         # default from $BNSGCN_CACHE_DIR — point it at
@@ -339,6 +355,17 @@ def create_parser() -> argparse.ArgumentParser:
     both("dump-embeddings", type=str, default="",
          help="write the all-node embedding table (+ integrity header) "
               "here after eval — serve.py cold-starts from it")
+    # observability (obs.py)
+    p.add_argument("--obs", type=str, default="on", choices=["on", "off"],
+                   help="unified telemetry bus: metrics registry + "
+                        "structured JSONL event log + post-mortem capture "
+                        "(off = the exact pre-obs loop, bit-identical)")
+    both("obs-log", type=str, default=os.environ.get("BNSGCN_OBS_LOG", ""),
+         help="structured JSONL event log path (rank-tagged; ranks > 0 "
+              "write PATH.r<rank>; size-bounded, $BNSGCN_OBS_MAX_MB)")
+    both("obs-dir", type=str, default="",
+         help="post-mortem dir for watchdog/divergence dumps and SIGUSR1 "
+              "snapshots (default {ckpt_path}/postmortem)")
     both("cache-dir", type=str,
          default=os.environ.get("BNSGCN_CACHE_DIR", ""))
     both("edge-chunk", type=int, default=0)

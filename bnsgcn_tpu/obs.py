@@ -1,0 +1,431 @@
+"""Unified telemetry bus: structured run/serve metrics + post-mortem trail.
+
+Every subsystem built since PR 1 emitted its own ad-hoc signals — EpochTimer
+buckets and wire-bytes header lines in run.py, liveness dumps in
+parallel/coord.py, bare counters in serve.py's `stats` op, stderr stack dumps
+from the watchdog — and none of it survived a run as a machine-readable
+artifact. The ROADMAP's standing campaigns (real-pod validation, the
+`.watch_queue` hardware-window measurements, papers100M epoch timing) all
+hinge on answering "where did the time/bytes go, on which rank, in which
+epoch" from a log AFTER the tunnel window closes. This module is the one
+place such signals land:
+
+* **Registry** — process-wide counters, gauges and fixed-log-bucket
+  streaming histograms (p50/p99 without sample storage: values land in
+  geometrically-spaced buckets, a quantile is the geometric midpoint of the
+  bucket holding it — bounded relative error, O(buckets) memory forever).
+* **EventLog** — a rank-tagged structured JSONL event log (`--obs-log PATH`
+  / `$BNSGCN_OBS_LOG`; ranks > 0 write `PATH.r<rank>`), size-bounded with
+  one-deep rotation (`PATH.1`) so a multi-day run can never fill a disk.
+  Every write is line-flushed: the log survives os._exit (the watchdog's
+  exit 77) with the triggering event on disk.
+* **Post-mortem capture** — `write_postmortem` drops all-thread stacks plus
+  a registry snapshot into `--obs-dir` (default `{ckpt_path}/postmortem`),
+  used by the watchdog/divergence dumps and the on-demand SIGUSR1 profile
+  window (resilience.PreemptSignals + run.py) so exits 75/76/77/78 leave
+  files, not just stderr.
+
+`--obs off` constructs none of this (make_obs returns None; every call site
+guards) and is pinned bitwise against `on` by tests/test_obs.py — the bus
+only ever reads host-side values the loop already fetched, never adds a
+device op. tools/obs_report.py renders a log (per-epoch table, comm-vs-
+compute split, serving percentiles, multi-rank merge, --compare).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import math
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "EventLog", "Obs",
+    "make_obs", "postmortem_dir", "write_postmortem", "load_events",
+    "rank_log_path",
+]
+
+
+# ----------------------------------------------------------------------------
+# metrics: counters, gauges, streaming histograms
+# ----------------------------------------------------------------------------
+
+class Counter:
+    """Monotonic count; thread-safe via the owning Registry's lock discipline
+    (increments are a single int add under the GIL — atomic enough for
+    telemetry; the registry snapshot takes the lock for consistency)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1):
+        self.value += int(n)
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float):
+        self.value = float(v)
+
+
+class Histogram:
+    """Fixed-log-bucket streaming histogram: p50/p99 without sample storage.
+
+    Bucket i holds values in [lo * growth^(i-1), lo * growth^i); bucket 0 is
+    the underflow (< lo, including 0/negatives), the last the overflow. A
+    quantile is the geometric midpoint of the bucket the target count falls
+    in, so the relative error is bounded by sqrt(growth) - 1 (~4.4% at the
+    default growth 2^(1/8)) — tests/test_obs.py pins known-quantile inputs.
+    Memory is the bucket array, constant for the life of the run."""
+
+    __slots__ = ("lo", "growth", "_log_g", "n", "counts", "count", "total",
+                 "vmin", "vmax")
+
+    def __init__(self, lo: float = 1e-4, growth: float = 2 ** 0.125,
+                 n_buckets: int = 256):
+        self.lo = float(lo)
+        self.growth = float(growth)
+        self._log_g = math.log(growth)
+        self.n = int(n_buckets)
+        self.counts = [0] * (self.n + 2)    # [underflow, n buckets, overflow]
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    def _idx(self, v: float) -> int:
+        if v < self.lo:
+            return 0
+        i = 1 + int(math.log(v / self.lo) / self._log_g)
+        return min(i, self.n + 1)
+
+    def observe(self, v: float):
+        v = float(v)
+        if not math.isfinite(v):
+            return      # a NaN/inf measurement is dropped, never a crash —
+                        # the bus's contract is that telemetry cannot kill
+                        # the subsystem feeding it (int(nan) would raise)
+        self.counts[self._idx(v)] += 1
+        self.count += 1
+        self.total += v
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+
+    def _bucket_mid(self, i: int) -> float:
+        if i <= 0:
+            return min(self.lo, self.vmin)
+        if i >= self.n + 1:
+            return max(self.lo * self.growth ** self.n, self.vmax)
+        # geometric midpoint of [lo*g^(i-1), lo*g^i)
+        return self.lo * self.growth ** (i - 0.5)
+
+    def percentile(self, q: float) -> float:
+        """Value at quantile q in [0, 100]; 0.0 for an empty histogram."""
+        if self.count == 0:
+            return 0.0
+        target = max(q / 100.0 * self.count, 1.0)
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                # clamp into the observed range: a single-bucket histogram
+                # must not report a midpoint outside [vmin, vmax]
+                return float(min(max(self._bucket_mid(i), self.vmin),
+                                 self.vmax))
+        return float(self.vmax)
+
+    def snapshot(self) -> dict:
+        if self.count == 0:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "p50": 0.0, "p90": 0.0, "p99": 0.0}
+        return {"count": self.count, "sum": round(self.total, 6),
+                "min": round(self.vmin, 6), "max": round(self.vmax, 6),
+                "p50": round(self.percentile(50), 6),
+                "p90": round(self.percentile(90), 6),
+                "p99": round(self.percentile(99), 6)}
+
+
+class Registry:
+    """Process-wide named metrics. Names are '/'-joined paths (e.g.
+    'serve/latency_ms/A'); creation is idempotent and thread-safe, so any
+    subsystem can grab its instruments without coordination."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._hists: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str, **kw) -> Histogram:
+        with self._lock:
+            hit = self._hists.get(name)
+            if hit is None:
+                hit = self._hists[name] = Histogram(**kw)
+            return hit
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: round(g.value, 6)
+                           for k, g in self._gauges.items()},
+                "histograms": {k: h.snapshot()
+                               for k, h in self._hists.items()},
+            }
+
+
+# ----------------------------------------------------------------------------
+# the structured JSONL event log
+# ----------------------------------------------------------------------------
+
+def _sanitize(v):
+    """Strict-JSON-safe copy: non-finite floats (the NaN loss a rollback
+    event exists to record) become their string form instead of the bare
+    `NaN` token Python's json would emit — every line must parse under a
+    strict reader, not just under json.loads."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return str(v)
+    if isinstance(v, dict):
+        return {k: _sanitize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize(x) for x in v]
+    return v
+
+class EventLog:
+    """Rank-tagged JSONL writer, size-bounded with one-deep rotation.
+
+    Each `emit` appends one line `{"ts", "kind", "rank", ...fields}` and
+    flushes — the log must survive os._exit (watchdog 77) with the
+    triggering event on disk. When the file would exceed `max_bytes`
+    (default $BNSGCN_OBS_MAX_MB = 64 MB) it rotates to `<path>.1`
+    (overwriting the previous rotation), bounding total disk at ~2x the
+    limit for the run's lifetime. Write failures disable the log with one
+    stderr note — telemetry must never kill the run it observes."""
+
+    def __init__(self, path: str, rank: int = 0,
+                 max_bytes: Optional[int] = None):
+        self.path = path
+        self.rank = int(rank)
+        if max_bytes is None:
+            try:
+                max_bytes = float(os.environ.get("BNSGCN_OBS_MAX_MB",
+                                                 64)) * 2 ** 20
+            except ValueError:
+                # a typo'd env var must degrade, not crash-loop the run the
+                # bus exists to observe (same contract as the open guard)
+                sys.stderr.write("[obs] bad $BNSGCN_OBS_MAX_MB "
+                                 f"{os.environ['BNSGCN_OBS_MAX_MB']!r}; "
+                                 "using 64\n")
+                max_bytes = 64 * 2 ** 20
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._f = None
+        self._size = 0
+        self._dead = False
+        try:
+            self._open()
+        except OSError as ex:
+            # an unwritable $BNSGCN_OBS_LOG must degrade to a no-log run,
+            # not crash-loop every watchdog5 relaunch before training starts
+            self._dead = True
+            sys.stderr.write(f"[obs] cannot open event log {path}: "
+                             f"{type(ex).__name__}: {ex}; telemetry log "
+                             f"disabled for this run\n")
+
+    def _open(self):
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "a")
+        self._size = self._f.tell()
+
+    def emit(self, kind: str, **fields) -> Optional[dict]:
+        rec = {"ts": round(time.time(), 3), "kind": kind, "rank": self.rank}
+        rec.update(fields)
+        rec = _sanitize(rec)
+        line = json.dumps(rec, default=str) + "\n"
+        with self._lock:
+            self._write_locked(line)
+        return rec
+
+    def emit_bounded(self, kind: str, timeout_s: float = 2.0, **fields):
+        """Best-effort emit that gives up when the writer lock cannot be
+        acquired within `timeout_s`. For exit paths — the watchdog's 77
+        fires exactly when a wedged disk may have the MAIN thread stalled
+        inside emit() holding the lock; a blocking acquire here would
+        deadlock the escape hatch it is reporting."""
+        rec = _sanitize({"ts": round(time.time(), 3), "kind": kind,
+                         "rank": self.rank, **fields})
+        line = json.dumps(rec, default=str) + "\n"
+        if not self._lock.acquire(timeout=timeout_s):
+            return
+        try:
+            self._write_locked(line)
+        finally:
+            self._lock.release()
+
+    def _write_locked(self, line: str):
+        if self._dead:
+            return
+        try:
+            if self._size + len(line) > self.max_bytes and self._size > 0:
+                self._f.close()
+                os.replace(self.path, self.path + ".1")
+                self._open()
+            self._f.write(line)
+            self._f.flush()
+            self._size += len(line)
+        except (OSError, ValueError) as ex:
+            self._dead = True
+            sys.stderr.write(f"[obs] event log {self.path} disabled: "
+                             f"{type(ex).__name__}: {ex}\n")
+
+    def close(self):
+        with self._lock:
+            if self._f is not None and not self._dead:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+            self._f = None
+            self._dead = True
+
+
+def load_events(path: str, rotated: bool = True) -> list[dict]:
+    """Parse a JSONL event log (optionally prepending its `.1` rotation),
+    skipping torn lines — a reader must work on the log of a crashed run."""
+    out: list[dict] = []
+    paths = ([path + ".1"] if rotated and os.path.exists(path + ".1")
+             else []) + [path]
+    for p in paths:
+        try:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue        # torn final line of a killed run
+        except OSError:
+            continue
+    return out
+
+
+# ----------------------------------------------------------------------------
+# the facade run.py / serve.py / resilience.py thread through
+# ----------------------------------------------------------------------------
+
+class Obs:
+    """One per run: a registry plus an optional event log. Without a log
+    path the registry still works (serve's `stats`/`metrics` ops) and
+    `emit` is a no-op — so default runs pay nothing but a dict lookup."""
+
+    def __init__(self, path: str = "", rank: int = 0):
+        self.rank = int(rank)
+        self.registry = Registry()
+        self.log_path = path or ""
+        self.events = EventLog(path, rank=rank) if path else None
+
+    def emit(self, kind: str, **fields):
+        if self.events is not None:
+            self.events.emit(kind, **fields)
+
+    def emit_bounded(self, kind: str, **fields):
+        """Never-blocking variant for exit paths (watchdog): skips the
+        event rather than wait on a lock a stalled writer may hold."""
+        if self.events is not None:
+            self.events.emit_bounded(kind, **fields)
+
+    def snapshot(self) -> dict:
+        return self.registry.snapshot()
+
+    def close(self):
+        if self.events is not None:
+            self.events.close()
+
+
+def rank_log_path(path: str, rank: int) -> str:
+    """Per-rank event-log file: rank 0 owns the bare path, every other rank
+    writes `<path>.r<rank>` — two coordinated processes handed the same
+    --obs-log must never interleave writes into one file."""
+    return path if rank == 0 or not path else f"{path}.r{rank}"
+
+
+def make_obs(cfg, rank: int = 0, log=print) -> Optional[Obs]:
+    """Obs for this run, or None under `--obs off` (every call site guards —
+    off constructs nothing: no registry, no file, no signal handler)."""
+    if getattr(cfg, "obs", "on") != "on":
+        return None
+    path = cfg.obs_log or os.environ.get("BNSGCN_OBS_LOG", "")
+    path = rank_log_path(path, rank)
+    obs = Obs(path, rank=rank)
+    if path:
+        log(f"[obs] event log -> {path}")
+    return obs
+
+
+# ----------------------------------------------------------------------------
+# post-mortem capture (watchdog 77, divergence 76, SIGUSR1 snapshots)
+# ----------------------------------------------------------------------------
+
+def postmortem_dir(cfg) -> str:
+    """Where exits 75/76/77/78 leave their files: `--obs-dir`, default
+    `{ckpt_path}/postmortem`."""
+    return getattr(cfg, "obs_dir", "") or os.path.join(cfg.ckpt_path,
+                                                       "postmortem")
+
+
+def write_postmortem(dirpath: str, tag: str, text: str = "",
+                     registry: Optional[Registry] = None,
+                     stacks: bool = True) -> str:
+    """Write `<tag>_<pid>.txt` (free text + all-thread stacks) and, when a
+    registry is given, `<tag>_<pid>_metrics.json` (its snapshot) under
+    `dirpath`. Returns the text file's path, or "" when the write failed
+    (disk full — the exact condition post-mortems target): callers must
+    not advertise a breadcrumb that does not exist. Never raises; the
+    degraded fallback is the stderr dump the caller already made."""
+    try:
+        os.makedirs(dirpath, exist_ok=True)
+        base = os.path.join(dirpath, f"{tag}_{os.getpid()}")
+        path = base + ".txt"
+        with open(path, "w") as f:
+            if text:
+                f.write(text.rstrip("\n") + "\n")
+            if stacks:
+                f.write("\n--- all-thread stacks ---\n")
+                f.flush()
+                faulthandler.dump_traceback(file=f, all_threads=True)
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        return ""
+    if registry is not None:
+        try:
+            with open(base + "_metrics.json", "w") as f:
+                json.dump(registry.snapshot(), f, indent=1)
+        except OSError:
+            pass
+    return path

@@ -455,6 +455,10 @@ class Coordinator:
         self.transport = transport
         self.timeout_s = float(timeout_s)
         self.log = log
+        self.last_infos: dict[int, dict] = {}   # rank 0: the piggybacked
+                            # per-rank info payloads of the latest agree()
+                            # (obs epoch summaries — merged into ONE
+                            # cross-rank record with no extra collective)
         self._seq = 0       # collective counter: all ranks call collectives
                             # in lockstep, so equal seq == the same exchange
         self._spent: list[tuple[int, list[str]]] = []   # rank 0: (seq, keys)
@@ -594,8 +598,8 @@ class Coordinator:
     # -- collectives (lockstep call order across ranks) --
 
     def agree(self, epoch: int, state: str,
-              decide_fn: Optional[Callable[[str, dict], dict]] = None
-              ) -> dict:
+              decide_fn: Optional[Callable[[str, dict], dict]] = None,
+              info: Optional[dict] = None) -> dict:
         """The per-step-boundary agreed verdict.
 
         Every rank contributes its local state; rank 0 reduces worst-wins
@@ -605,17 +609,38 @@ class Coordinator:
         escalating to abort when retries are exhausted. Terminal decisions
         (anything but 'ok') are confirmed by every rank before rank 0
         returns, so a rank about to exit can never strand a peer that has
-        not yet read the verdict."""
+        not yet read the verdict.
+
+        `info` piggybacks a small host-side payload (the obs epoch summary:
+        loss, step ms) on the verdict this exchange already carries — rank 0
+        exposes the gathered `{rank: info}` as `self.last_infos`, so a
+        merged cross-rank record costs NO new collective. A rank that
+        passes no info keeps the historical bare-string wire value."""
         seq = self._seq
         self._seq += 1
         self.heartbeat(epoch, self.STEP_KEY)
         deadline = self._deadline()
-        self._put(f"v/{seq}/{self.rank}", state, deadline)
+        self._put(f"v/{seq}/{self.rank}",
+                  state if info is None
+                  else json.dumps({"s": state, "i": info}), deadline)
         if self.rank == 0:
+            def _parse(v):
+                if v.startswith("{"):
+                    try:
+                        d = json.loads(v)
+                        return str(d.get("s", "abort")), d.get("i")
+                    except ValueError:
+                        return "abort", None
+                return v, None
+
             states = {0: state}
+            self.last_infos = {0: info} if info is not None else {}
             for r in range(1, self.world):
-                states[r] = self._get(f"v/{seq}/{r}", deadline,
-                                      f"rank {r}'s epoch-{epoch} verdict")
+                s, i = _parse(self._get(f"v/{seq}/{r}", deadline,
+                                        f"rank {r}'s epoch-{epoch} verdict"))
+                states[r] = s
+                if i is not None:
+                    self.last_infos[r] = i
             name = reduce_states(states)
             decision = {"decision": name, "epoch": int(epoch),
                         "states": {str(r): s for r, s in states.items()}}

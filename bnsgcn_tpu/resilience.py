@@ -67,6 +67,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu import obs as obs_mod
 from bnsgcn_tpu.parallel.coord import CoordAbort
 
 # Distinct exit codes so a requeue wrapper (the tools/tpu_watchdog5.sh role,
@@ -119,21 +120,35 @@ class PreemptSignals:
     the exact same handler semantics the training loop checkpoints with.
 
     `action` is the one-line promise printed on the first signal — what the
-    owner will do at its `boundary` before exiting EXIT_PREEMPTED."""
+    owner will do at its `boundary` before exiting EXIT_PREEMPTED.
+
+    `profile=True` additionally claims SIGUSR1 as the ON-DEMAND PROFILING
+    signal (the obs telemetry bus): the handler only sets a flag; the owner
+    polls `take_profile_request()` at its boundary and captures a bounded
+    jax.profiler trace window + all-thread stacks + registry snapshot into
+    the post-mortem dir WITHOUT stopping training (run.py's loop)."""
 
     def __init__(self, action: str = "checkpoint",
-                 boundary: str = "step boundary"):
+                 boundary: str = "step boundary", profile: bool = False):
         self.action = action
         self.boundary = boundary
+        self.profile = profile
         self._requested: Optional[str] = None
+        self._profile_requested = False
         self._old_handlers: dict = {}
 
     def install(self):
         """Main thread only — a worker-thread owner just skips them."""
         if threading.current_thread() is threading.main_thread():
-            for sig in (signal.SIGTERM, signal.SIGINT):
+            sigs = [signal.SIGTERM, signal.SIGINT]
+            if self.profile and hasattr(signal, "SIGUSR1"):
+                sigs.append(signal.SIGUSR1)
+            for sig in sigs:
                 try:
-                    self._old_handlers[sig] = signal.signal(sig, self._on_signal)
+                    handler = (self._on_profile
+                               if self.profile and hasattr(signal, "SIGUSR1")
+                               and sig == signal.SIGUSR1 else self._on_signal)
+                    self._old_handlers[sig] = signal.signal(sig, handler)
                 except (ValueError, OSError):
                     pass
         return self
@@ -158,6 +173,21 @@ class PreemptSignals:
             f"\n[resilience] {name} received: will {self.action} and exit "
             f"{EXIT_PREEMPTED} at the next {self.boundary} (send again to "
             f"kill immediately)\n")
+
+    def _on_profile(self, signum, frame):
+        # flag only — the owner's boundary does the capture (a signal
+        # handler must never touch jax/profiler state mid-step)
+        self._profile_requested = True
+        sys.stderr.write(
+            "\n[obs] SIGUSR1 received: will capture stacks + metrics + a "
+            "bounded profiler window at the next step boundary\n")
+
+    def take_profile_request(self) -> bool:
+        """True exactly once per SIGUSR1 — the owner consumes the flag."""
+        if self._profile_requested:
+            self._profile_requested = False
+            return True
+        return False
 
     @property
     def requested(self) -> Optional[str]:
@@ -231,10 +261,12 @@ class _Watchdog(threading.Thread):
     ALIVE_BEAT_S = 2.0      # coord: watchdog-thread heartbeat period, so
                             # peers can tell "process dead" from "step slow"
 
-    def __init__(self, log=print, coord=None):
+    def __init__(self, log=print, coord=None, postmortem_dir=None, obs=None):
         super().__init__(name="bnsgcn-watchdog", daemon=True)
         self.log = log
         self.coord = coord
+        self.postmortem_dir = postmortem_dir    # obs on: the stack dump is
+        self.obs = obs                          # also a FILE, not just stderr
         self.grace_s = float(os.environ.get("BNSGCN_WATCHDOG_GRACE_S", 600))
         self.factor = float(os.environ.get("BNSGCN_WATCHDOG_FACTOR", 20))
         # floor of 300 s: epoch-boundary work that is slow-but-legit (a
@@ -296,7 +328,17 @@ class _Watchdog(threading.Thread):
             deadline = self.deadline_s()
             if idle <= deadline:
                 continue
-            self._dump(idle, deadline)
+            # the dump runs in its OWN daemon thread with a bounded join:
+            # the 77 exit fires exactly when a wedged disk/NFS may block
+            # any file write (or the obs writer lock) forever, and the
+            # escape hatch must stay reachable regardless
+            t = threading.Thread(target=self._dump, args=(idle, deadline),
+                                 name="bnsgcn-watchdog-dump", daemon=True)
+            t.start()
+            t.join(timeout=30.0)
+            if t.is_alive():
+                sys.stderr.write("[watchdog] dump stalled (wedged "
+                                 "filesystem?); exiting without it\n")
             os._exit(EXIT_WATCHDOG)
 
     def _dump(self, idle: float, deadline: float):
@@ -328,6 +370,34 @@ class _Watchdog(threading.Thread):
                         write=lambda s: sys.stderr.write(s + "\n"))
                 except Exception:
                     pass
+            dump_path = ""
+            if self.postmortem_dir:
+                # exit 77 must leave a post-mortem FILE a requeue wrapper
+                # can point triage at after the tunnel window closes —
+                # stderr alone dies with the terminal scrollback. "" =
+                # write failed (disk full): no breadcrumb to a ghost file
+                dump_path = obs_mod.write_postmortem(
+                    self.postmortem_dir, f"watchdog_E{self._epoch}",
+                    text=(f"watchdog: no step-boundary heartbeat for "
+                          f"{idle:.1f}s (deadline {deadline:.1f}s, last "
+                          f"epoch {self._epoch}); exiting "
+                          f"{EXIT_WATCHDOG}"),
+                    registry=(self.obs.registry
+                              if self.obs is not None else None))
+                if dump_path:
+                    sys.stderr.write(
+                        f"[watchdog] post-mortem dump: {dump_path}\n")
+            if self.obs is not None:
+                # bounded, own try: neither an unwritable post-mortem dir
+                # nor a writer lock held by a disk-stalled main thread may
+                # cost (or deadlock) the exit this event reports
+                try:
+                    self.obs.emit_bounded("watchdog_fire", epoch=self._epoch,
+                                          idle_s=round(idle, 1),
+                                          deadline_s=round(deadline, 1),
+                                          dump=dump_path or None)
+                except Exception:
+                    pass
             sys.stderr.flush()
         except Exception:
             pass    # dumping must never mask the exit itself
@@ -348,11 +418,18 @@ class ResilienceManager:
     routed through `agree_step` so all ranks act together."""
 
     def __init__(self, cfg, log=print, start_epoch: int = 0,
-                 retry_nonce: int = 0, coord=None):
+                 retry_nonce: int = 0, coord=None, obs=None):
         self.cfg = cfg
         self.log = log
         self.start_epoch = start_epoch
         self.coord = coord
+        self.obs = obs          # telemetry bus (obs.py): every recovery
+                                # below leaves a structured lifecycle event
+                                # so exits 75/76/77/78 have a post-mortem
+                                # trail; None under --obs off (no event, no
+                                # file — the pre-obs paths verbatim)
+        self.postmortem_dir = (obs_mod.postmortem_dir(cfg)
+                               if obs is not None else None)
         self.rank = coord.rank if coord is not None else 0
         self.plan = FaultPlan.parse(
             cfg.inject or os.environ.get("BNSGCN_FAULT", ""), rank=self.rank)
@@ -368,12 +445,15 @@ class ResilienceManager:
         self.backoff_base = float(os.environ.get("BNSGCN_RETRY_BACKOFF_S", 1.0))
         self.backoff_cap = 30.0
         self.rollbacks: list[dict] = []     # surfaced on RunResult
-        self._signals = PreemptSignals(action="checkpoint")
+        self._signals = PreemptSignals(action="checkpoint",
+                                       profile=obs is not None)
         self._snapshot = None
         self._pending_payload = None    # rank 0: the checkpoint payload
                                         # plan_rollback just validated, so
                                         # coord_restore never re-reads it
-        self.watchdog = _Watchdog(log, coord=coord)
+        self.watchdog = _Watchdog(log, coord=coord,
+                                  postmortem_dir=self.postmortem_dir,
+                                  obs=obs)
 
     # -- lifecycle --
 
@@ -389,11 +469,20 @@ class ResilienceManager:
         self.watchdog.join(timeout=2.0)
         self._signals.restore()
 
-    # -- preemption --
+    # -- preemption / on-demand profiling --
 
     @property
     def preempt_requested(self) -> Optional[str]:
         return self._signals.requested
+
+    def take_profile_request(self) -> bool:
+        """True once per SIGUSR1 (--obs on only): run.py's loop answers it
+        with a post-mortem snapshot + a bounded profiler trace window."""
+        return self._signals.take_profile_request()
+
+    def _emit(self, kind: str, **fields):
+        if self.obs is not None:
+            self.obs.emit(kind, **fields)
 
     # -- divergence rollback --
 
@@ -455,6 +544,9 @@ class ResilienceManager:
         self.nonce += 1
         self.rollbacks.append({"epoch": epoch, "restart": restart,
                                "source": src, "nonce": self.nonce})
+        self._emit("rollback", epoch=int(epoch), restart=int(restart),
+                   source=src, nonce=int(self.nonce), loss=float(loss_f),
+                   retry=self.retries, limit=limit)
         self.log(
             f"[resilience] non-finite training state at epoch {epoch} "
             f"(loss={loss_f}): rolled back to {src}, restarting at epoch "
@@ -485,17 +577,39 @@ class ResilienceManager:
             report += f"\n  report written to {rp}"
         except OSError:
             pass
+        pm = ""
+        if self.postmortem_dir:
+            # exit 76 leaves the same diagnostic (plus stacks + metrics) in
+            # the post-mortem dir, next to the watchdog's exit-77 dumps —
+            # one place a requeue wrapper can point triage at ("" = write
+            # failed; no breadcrumb to a file that does not exist)
+            pm = obs_mod.write_postmortem(
+                self.postmortem_dir, f"divergence_E{epoch}", text=report,
+                registry=self.obs.registry if self.obs else None)
+            if pm:
+                report += f"\n  post-mortem dump: {pm}"
+        # emitted regardless of the dump outcome: a failed post-mortem
+        # write must not cost the lifecycle event (_emit no-ops without obs)
+        self._emit("divergence_abort", epoch=int(epoch),
+                   loss=float(loss_f), retries=self.retries - 1,
+                   dump=pm or None)
         return report
 
     # -- multi-host agreed verdicts (coord != None) --
 
-    def agree_step(self, epoch: int, state: str, loss_f: float = 0.0) -> dict:
+    def agree_step(self, epoch: int, state: str, loss_f: float = 0.0,
+                   summary: Optional[dict] = None) -> dict:
         """One step-boundary verdict exchange: contribute this rank's local
         state ('ok' | 'diverged' | 'preempted'), return the agreed decision
         every rank acts on. Rank 0 owns the reduce and — for 'rollback' —
         the checkpoint selection, restart epoch, retry nonce and backoff;
         non-0 ranks record the rollback from the decision so their
-        RunResult.rollbacks and nonce stay rank-consistent."""
+        RunResult.rollbacks and nonce stay rank-consistent.
+
+        `summary` (obs on only) piggybacks this rank's epoch telemetry
+        (loss, step ms) on the verdict value the exchange already carries;
+        rank 0 merges every rank's summary into ONE `epoch_ranks` event —
+        cross-rank per-epoch accounting with zero extra collectives."""
         decide = None
         if self.coord.rank == 0:
             def decide(name, states):
@@ -508,13 +622,26 @@ class ResilienceManager:
                     return {"decision": "abort", "why": "peer",
                             "report": f"a rank reported abort: {states}"}
                 return {"decision": "ok"}
-        decision = self.coord.agree(epoch, state, decide)
+        decision = self.coord.agree(epoch, state, decide, info=summary)
+        if (self.obs is not None and self.coord.rank == 0
+                and self.coord.last_infos):
+            self.obs.emit("epoch_ranks", epoch=int(epoch),
+                          decision=decision.get("decision", "ok"),
+                          ranks={str(r): i for r, i in
+                                 sorted(self.coord.last_infos.items())})
+        if decision.get("decision", "ok") != "ok":
+            self._emit("coord_decision", epoch=int(epoch),
+                       decision=decision["decision"], local_state=state)
         if decision["decision"] == "rollback" and self.coord.rank != 0:
             self.nonce = int(decision["nonce"])
             self.rollbacks.append({
                 "epoch": int(decision["epoch"]),
                 "restart": int(decision["restart"]),
                 "source": decision["source"], "nonce": self.nonce})
+            self._emit("rollback", epoch=int(decision["epoch"]),
+                       restart=int(decision["restart"]),
+                       source=decision["source"], nonce=int(self.nonce),
+                       agreed=True)
             self.log(
                 f"[resilience] agreed rollback (decided by rank 0): epoch "
                 f"{decision['epoch']} -> restart {decision['restart']} from "
@@ -556,6 +683,9 @@ class ResilienceManager:
         self.nonce += 1
         self.rollbacks.append({"epoch": epoch, "restart": restart,
                                "source": src, "nonce": self.nonce})
+        self._emit("rollback", epoch=int(epoch), restart=int(restart),
+                   source=src, nonce=int(self.nonce), loss=float(loss_f),
+                   retry=self.retries, limit=limit, agreed=True)
         diverged = sorted(r for r, s in (states or {}).items()
                           if s == "diverged")
         self.log(
@@ -641,6 +771,7 @@ class ResilienceManager:
         out = {"nan": self.plan.pop("nan", epoch)}
         if self.plan.pop("sigterm", epoch):
             self.log(f"[inject] sigterm@E{epoch}")
+            self._emit("inject", kind_injected="sigterm", epoch=int(epoch))
             signal.raise_signal(signal.SIGTERM)
         if self.plan.pop("ckpt-corrupt", epoch):
             latest = ckpt.latest_checkpoint(self.cfg)
@@ -656,6 +787,7 @@ class ResilienceManager:
                 time.sleep(3600)
         if out["nan"]:
             self.log(f"[inject] nan@E{epoch}: poisoning params")
+            self._emit("inject", kind_injected="nan", epoch=int(epoch))
         return out
 
 

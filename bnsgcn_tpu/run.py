@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu import obs as obs_mod
 from bnsgcn_tpu import resilience
 from bnsgcn_tpu.config import Config, ConfigError
 from bnsgcn_tpu.data.artifacts import (PartitionArtifacts, build_artifacts,
@@ -162,6 +163,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # checkpoint dir (and host eval), exactly like jax rank 0 does
             # in a real multi-host run
             is_rank0 = coord_rank == 0
+
+    # ---- telemetry bus (obs.py): rank-tagged structured event log +
+    # metrics registry. None under --obs off — every emit below is guarded,
+    # so off constructs nothing and stays bit-identical (pinned). ----
+    obs = obs_mod.make_obs(cfg, rank=coord_rank, log=log)
 
     # ---- data + eval graphs (train.py:313-319) ----
     # multi-host: only rank 0 ever needs the full undistributed graph (host
@@ -360,6 +366,27 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         + ("" if spec.use_pp or spec.model == "gat" else
            f" ({wire_bytes(hspec, _wire_w(max(cfg.n_feat, 1)), nb) / 1e6:.2f}"
            f" MB at layer-0 feature width {cfg.n_feat})"))
+
+    # one machine-readable run header: everything the per-run log line above
+    # says, plus the config the run is actually executing — the record
+    # obs_report joins epochs/lifecycle events against
+    halo_wire_mb = wire_bytes(hspec, hid_w, nb) / 1e6
+    if obs is not None:
+        obs.emit(
+            "run_header", mesh=mesh_desc(mesh),
+            replicas=int(fns.n_replicas), parts=int(cfg.n_partitions),
+            feat=int(fns.n_feat), halo=halo_label, wire=hspec.wire,
+            wire_mb_per_exchange=round(halo_wire_mb, 4),
+            partition={"pad_inner": int(art.pad_inner),
+                       "pad_boundary": int(art.pad_boundary),
+                       "pad_send": int(hspec.pad_send),
+                       "edges_per_part": int(art.pad_edges)},
+            config={k: getattr(cfg, k) for k in (
+                "dataset", "graph_name", "model", "n_layers", "n_hidden",
+                "heads", "sampling_rate", "lr", "dtype", "spmm",
+                "use_pallas", "spmm_gather", "spmm_dense", "halo_exchange",
+                "halo_wire", "overlap", "n_epochs", "log_every", "seed",
+                "inductive", "use_pp", "resilience", "coord")})
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
     mesh_eval = cfg.eval and cfg.eval_device == "mesh"
@@ -660,7 +687,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     if cfg.resilience == "on" and (not multi_host or coordinator is not None):
         resil = resilience.ResilienceManager(cfg, log, start_epoch=start_epoch,
                                              retry_nonce=retry_nonce,
-                                             coord=coordinator)
+                                             coord=coordinator, obs=obs)
         # host snapshot of the fresh/resumed state: the rollback target
         # until the first periodic checkpoint exists (under coordination,
         # every rank keeps one — the '<initial state>' source restores it
@@ -752,11 +779,16 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             log(f"[resilience] host eval for epoch {e} failed "
                 f"({type(err).__name__}: {err}); continuing training")
             return None
+        if obs is not None:
+            obs.emit("eval", epoch=e, val_acc=round(float(out[1]), 6))
         return out
 
     loss = jnp.zeros(())
     loss_f = 0.0
     trace_done = False          # one trace window per run, even across rollbacks
+    # on-demand profiling (SIGUSR1, obs on): a bounded profiler window into
+    # the post-mortem dir, captured WITHOUT stopping training
+    usr1_tracing, usr1_stop, usr1_dir = False, -1, None
     loss_base = start_epoch     # epoch of res.losses[0]: a rollback behind the
                                 # resume point (newer ckpts all corrupt) rebases
                                 # the list instead of corrupting its indexing
@@ -777,8 +809,39 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                         lambda x: x * jnp.nan
                         if jnp.issubdtype(x.dtype, jnp.floating) else x,
                         params)
-            if (trace_dir and epoch == prof_start and prof_stop > prof_start
-                    and not tracing and not trace_done):
+                # ---- on-demand profiling: SIGUSR1 was received; capture
+                # stacks + registry snapshot NOW and trace a bounded window
+                # of the next epochs into the post-mortem dir — training
+                # never stops ----
+                if obs is not None and resil.take_profile_request():
+                    pm = resil.postmortem_dir or obs_mod.postmortem_dir(cfg)
+                    snap = obs_mod.write_postmortem(
+                        pm, f"sigusr1_E{epoch}",
+                        text=f"SIGUSR1 snapshot at epoch {epoch}",
+                        registry=obs.registry) or None
+                    if snap:
+                        log(f"[obs] SIGUSR1: stacks + metrics snapshot -> "
+                            f"{snap}")
+                    else:
+                        log("[obs] SIGUSR1: post-mortem snapshot write "
+                            "FAILED (disk?); still arming the trace window")
+                    if not tracing and not usr1_tracing:
+                        usr1_dir = os.path.join(pm, f"trace_E{epoch}")
+                        try:
+                            jax.profiler.start_trace(usr1_dir)
+                            usr1_tracing, usr1_stop = True, epoch + 2
+                            log(f"[obs] SIGUSR1: profiling epochs {epoch}.."
+                                f"{usr1_stop} -> {usr1_dir}")
+                        except Exception as ex:
+                            log(f"[obs] SIGUSR1 trace failed to start: {ex}")
+                            usr1_dir = None
+                    obs.emit("profile_request", epoch=epoch, snapshot=snap,
+                             trace_dir=usr1_dir if usr1_tracing else None)
+                    resil.watchdog.touch()      # capture is boundary work
+            # >= (not ==): a SIGUSR1 window covering prof_start must only
+            # DELAY the one-shot comm-trace start, never cancel it
+            if (trace_dir and prof_start <= epoch < prof_stop
+                    and not tracing and not trace_done and not usr1_tracing):
                 jax.profiler.start_trace(trace_dir)
                 tracing = True
             t0 = time.perf_counter()
@@ -788,15 +851,19 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             loss.block_until_ready()
             dt = time.perf_counter() - t0
             loss_f = float(loss)
+            usr1_in_step = usr1_tracing     # profiler overhead rides dt
 
             # ---- divergence guard: free loss check every step (the loop
             # fetched it for res.losses anyway) + param-norm probe every
             # log_every; rollback BEFORE the checkpoint write below so a
             # non-finite state can never become "last good" ----
             bad = resil is not None and not math.isfinite(loss_f)
+            pnorm = None        # the probe's value, reused by the obs epoch
+                                # record — never an extra device op
             if (resil is not None and not bad
                     and (epoch + 1) % cfg.log_every == 0):
-                bad = not math.isfinite(float(param_global_norm(params)))
+                pnorm = float(param_global_norm(params))
+                bad = not math.isfinite(pnorm)
             if resil is not None and resil.coord is not None:
                 # ---- multi-host agreed verdict: every rank contributes
                 # its local state out-of-band, rank 0 reduces worst-wins,
@@ -806,7 +873,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 local = ("diverged" if bad
                          else "preempted" if resil.preempt_requested
                          else "ok")
-                decision = resil.agree_step(epoch, local, loss_f)
+                # piggyback this rank's epoch telemetry on the verdict the
+                # exchange already carries: rank 0 merges every rank's
+                # summary into ONE epoch_ranks record, no new collective
+                summary = ({"loss": round(loss_f, 6),
+                            "step_ms": round(dt * 1e3, 3)}
+                           if obs is not None else None)
+                decision = resil.agree_step(epoch, local, loss_f,
+                                            summary=summary)
                 act = decision["decision"]
                 if act == "abort":
                     resil.raise_abort(decision)
@@ -826,6 +900,9 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                         f"rank(s) {decision.get('ranks')}) at the epoch-"
                         f"{epoch} step boundary: resumable checkpoint at "
                         f"{ppath}")
+                    if obs is not None:
+                        obs.emit("preempt", epoch=epoch, ckpt=ppath,
+                                 agreed=True, ranks=decision.get("ranks"))
                     raise resilience.PreemptedError(epoch, ppath)
                 if act == "rollback":
                     templates = (jax.device_get(params),
@@ -896,6 +973,13 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                           if trace_events is not None else None)
                 if parsed is not None:
                     comm_traced, reduce_traced = parsed[0], parsed[1]
+                    if obs is not None:
+                        # the comm-vs-compute split obs_report renders:
+                        # trace-derived in-step collective seconds per epoch
+                        obs.emit("trace", epoch=epoch,
+                                 comm_s=round(comm_traced, 6),
+                                 reduce_s=round(reduce_traced, 6),
+                                 trace_dir=cfg.profile_dir or None)
                     # drop the microbench samples recorded so far so the
                     # printed means are purely the traced in-step numbers;
                     # seed one sample immediately — the window-closing epoch
@@ -917,6 +1001,13 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                         for k in ("exchange_ms", "interior_ms", "frontier_ms",
                                   "hidden_ms"):
                             timer.record_bucket(k, rep[k])
+                        if obs is not None:
+                            obs.emit("overlap", epoch=epoch,
+                                     **{k: round(float(rep[k]), 4)
+                                        for k in ("exchange_ms",
+                                                  "interior_ms",
+                                                  "frontier_ms", "hidden_ms")},
+                                     overlapped=bool(rep["overlapped"]))
                         log("overlap[traced]: exchange {exchange_ms:.3f} ms | "
                             "interior {interior_ms:.3f} ms | frontier "
                             "{frontier_ms:.3f} ms | hidden {hidden_ms:.3f} ms "
@@ -931,6 +1022,23 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                             "gives the full report)")
                 if auto_trace_dir:
                     shutil.rmtree(auto_trace_dir, ignore_errors=True)
+
+            # ---- SIGUSR1 bounded window closes here; training continues ----
+            if usr1_tracing and epoch >= usr1_stop:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                usr1_tracing = False
+                log(f"[obs] SIGUSR1 profiler window written to {usr1_dir}")
+                if obs is not None:
+                    obs.emit("profile", epoch=epoch, trace_dir=usr1_dir)
+                if trace_dir and not trace_done and epoch >= prof_start:
+                    # the SIGUSR1 window swallowed (part of) the one-shot
+                    # comm-trace window: re-arm it right after — delayed,
+                    # never cancelled
+                    prof_start = epoch + 1
+                    prof_stop = min(prof_start + 3, cfg.n_epochs - 1)
 
             if comm_traced is not None:
                 comm_t = comm_traced
@@ -947,10 +1055,31 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # overhead in dt — exclude them from the reported means like
             # warmup epochs (same rule as bench.py, whose traced runs are
             # tagged profiled-diagnostic and never update best_known)
-            if not (trace_dir and prof_start <= epoch <= prof_stop):
+            clean_step = (not (trace_dir and prof_start <= epoch <= prof_stop)
+                          and not usr1_in_step)
+            if clean_step:
                 timer.record(epoch, dt, comm_t,
                              reduce_traced if reduce_traced is not None else 0.0)
             res.losses.append(loss_f)
+
+            if obs is not None:
+                # the per-epoch record everything downstream joins on; the
+                # registry histogram gives p50/p99 step time without storing
+                # samples (the snapshot rides post-mortem dumps). Same
+                # exclusions as timer.record — compile/warmup and profiled
+                # epochs must not report as p99 step time
+                if clean_step and epoch >= timer.warmup:
+                    obs.registry.histogram("train/step_s").observe(dt)
+                rec = {"epoch": epoch, "loss": round(loss_f, 6),
+                       "step_s": round(dt, 6),
+                       "wire_mb": round(halo_wire_mb, 4)}
+                if pnorm is not None:
+                    rec["param_norm"] = round(pnorm, 6)
+                if comm_t:
+                    rec["comm_s"] = round(comm_t, 6)
+                    rec["comm_tag"] = ("traced" if comm_traced is not None
+                                       else "sampled")
+                obs.emit("epoch", **rec)
 
             if (epoch + 1) % cfg.log_every == 0:
                 mt, mc, mr = timer.means()
@@ -982,6 +1111,10 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 accs = evaluate_mesh("Epoch %05d" % epoch, fns_e.eval_forward,
                                      params, state, blk_e, tf_e, art_e, modes,
                                      result_file)
+                if obs is not None:
+                    obs.emit("eval", epoch=epoch,
+                             **{f"{m}_acc": round(float(accs[m]), 6)
+                                for m in modes})
                 if accs["val"] > best_acc:
                     best_acc, best_params = accs["val"], jax.device_get(params)
             elif cfg.eval and is_rank0 and (epoch + 1) % cfg.log_every == 0:
@@ -1038,25 +1171,45 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 log(f"[resilience] {resil.preempt_requested} honored at the "
                     f"epoch-{epoch} step boundary: resumable checkpoint at "
                     f"{ppath}")
+                if obs is not None:
+                    obs.emit("preempt", epoch=epoch, ckpt=ppath,
+                             signal=resil.preempt_requested)
                 raise resilience.PreemptedError(epoch, ppath)
             epoch += 1
     finally:
         # trace-window leak fix: a crash/preemption anywhere in the loop
         # (including the normal shorter-than-prof_stop ending) must not
         # leave a dangling profiler session or the auto temp dir behind
-        if tracing:
+        if tracing or usr1_tracing:
             try:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
-            tracing = False
-            if cfg.profile_dir:
+            if usr1_tracing:
+                # the open trace was the SIGUSR1 one (the two never overlap)
+                # — announce ITS location and complete its event pair, not
+                # the comm-trace's profile_dir
+                log(f"[obs] SIGUSR1 profiler window written to {usr1_dir}")
+                if obs is not None:
+                    obs.emit("profile", epoch=epoch, trace_dir=usr1_dir,
+                             at_exit=True)
+            elif cfg.profile_dir:
                 log(f"profiler trace written to {cfg.profile_dir}")
+            tracing = usr1_tracing = False
         if auto_trace_dir:
             shutil.rmtree(auto_trace_dir, ignore_errors=True)
         if resil is not None:
             res.rollbacks = list(resil.rollbacks)
             resil.close()
+        if obs is not None and sys.exc_info()[0] is not None:
+            # an interrupted run (preempt 75, divergence 76, abort 78 —
+            # anything raising out of the loop) still ends its log with a
+            # terminal record; the normal path emits a richer run_end below
+            mt_i, _, _ = timer.means()
+            obs.emit("run_end", interrupted=sys.exc_info()[0].__name__,
+                     epochs_done=len(res.losses), final_loss=loss_f,
+                     epoch_time_s=round(mt_i, 6))
+            obs.close()
         if coordinator is not None:
             # terminal decisions (preempt/abort) were already confirmed by
             # every peer inside agree(); a NORMAL completion still needs a
@@ -1157,4 +1310,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             log(f"[serve] embedding table [{hidden.shape[0]} x "
                 f"{hidden.shape[1]}] + logits [{logits.shape[1]} classes] "
                 f"-> {cfg.dump_embeddings} ({time.time() - t0:.1f}s)")
+    if obs is not None:
+        obs.emit("run_end", final_loss=res.final_loss,
+                 epoch_time_s=round(res.epoch_time, 6),
+                 comm_time_s=round(res.comm_time, 6),
+                 reduce_time_s=round(res.reduce_time, 6),
+                 best_val_acc=round(res.best_val_acc, 6),
+                 test_acc=round(res.test_acc, 6),
+                 rollbacks=len(res.rollbacks),
+                 step_hist=obs.registry.histogram("train/step_s").snapshot())
+        obs.close()
     return res

@@ -55,6 +55,7 @@ from typing import Iterable, Optional
 import numpy as np
 
 from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu import obs as obs_mod
 from bnsgcn_tpu import resilience
 from bnsgcn_tpu.config import Config, ConfigError, parse_config
 from bnsgcn_tpu.data.graph import Graph
@@ -383,7 +384,7 @@ class ServeCore:
 
     def __init__(self, cfg: Config, spec: ModelSpec, graph: DynamicGraph,
                  params, state, hidden: np.ndarray, logits: np.ndarray,
-                 log=print):
+                 log=print, obs: Optional[obs_mod.Obs] = None):
         if hidden.shape[0] != graph.n_nodes or logits.shape[0] != graph.n_nodes:
             raise ConfigError(
                 f"embedding table rows ({hidden.shape[0]}/{logits.shape[0]}) "
@@ -398,6 +399,16 @@ class ServeCore:
         self.logits = logits
         self.hops = spec.n_graph_layers
         self.log = log
+        # registry-backed serving metrics (obs.py): per-tier latency
+        # histograms (p50/p99 without sample storage), refresh-lag, queue
+        # depth. The registry exists even without an event log — `stats`
+        # and the `metrics` op serve it over the wire either way.
+        self.obs = obs
+        self.registry = obs.registry if obs is not None else obs_mod.Registry()
+        self._lat = {t: self.registry.histogram(f"serve/latency_ms/{t}")
+                     for t in ("A", "B")}
+        self._lag_hist = self.registry.histogram("serve/refresh_lag_s")
+        self._dirty_since: dict[int, float] = {}    # node -> first dirty ts
         self.scorer = SubgraphScorer(spec, edge_chunk=cfg.edge_chunk)
         self.dirty: set[int] = set()
         self._refreshing: set[int] = set()  # claimed by an in-flight refresh
@@ -438,6 +449,7 @@ class ServeCore:
                 self.dirty.update(was_dirty)
             raise
         with self._lock:
+            now = time.monotonic()
             self._refreshing.difference_update(was_dirty)
             for t in was_dirty:
                 if t in self.dirty:         # re-dirtied mid-step: stale, skip
@@ -446,9 +458,16 @@ class ServeCore:
                 self.hidden[t] = hid
                 self.logits[t] = lg
                 self.stats["refreshed_nodes"] += 1
+                since = self._dirty_since.pop(t, None)
+                if since is not None:
+                    # refresh lag: how stale this row got before the fresh
+                    # score landed (the bounded-staleness figure the delta
+                    # pipeline promises)
+                    self._lag_hist.observe(now - since)
         return results
 
     def predict(self, node: int, tier: Optional[str] = None) -> dict:
+        t_in = time.perf_counter()
         node = int(node)
         self.graph._check(node)
         with self._lock:
@@ -472,6 +491,7 @@ class ServeCore:
                    "scores": np.asarray(lg).tolist()}
         if not self.cfg.multilabel:
             out["pred"] = int(np.argmax(out["scores"]))
+        self._lat[out["tier"]].observe((time.perf_counter() - t_in) * 1e3)
         return out
 
     def predict_many(self, nodes, tier: Optional[str] = None) -> list[dict]:
@@ -479,6 +499,7 @@ class ServeCore:
         bucket steps directly (the caller already holds the full target
         list — routing each node through the batcher one-by-one would
         serialize what this subsystem exists to coalesce)."""
+        t_in = time.perf_counter()
         nodes = [int(n) for n in nodes]
         for n in nodes:
             self.graph._check(n)
@@ -488,9 +509,18 @@ class ServeCore:
                      if n in self.dirty or n in self._refreshing}
         fresh = sorted({n for n in nodes if tier == "B" or n in stale})
         scored: dict[int, tuple] = {}
+        t_b0 = time.perf_counter()
         for i in range(0, len(fresh), self.cfg.serve_max_batch):
             scored.update(self._score_batch(
                 fresh[i:i + self.cfg.serve_max_batch]))
+        t_b = time.perf_counter() - t_b0
+        # per-tier attribution: the bucket-step time belongs to the tier-B
+        # nodes only — smearing the whole call over both tiers would inflate
+        # the tier-A percentiles ~1000x (a row lookup vs a compiled forward)
+        n_b = sum(1 for n in nodes if n in scored)
+        n_a = len(nodes) - n_b
+        per_b_ms = t_b * 1e3 / max(n_b, 1)
+        per_a_ms = ((time.perf_counter() - t_in - t_b) * 1e3 / max(n_a, 1))
         out = []
         for n in nodes:
             if n in scored:
@@ -508,10 +538,19 @@ class ServeCore:
                     r["stale"] = True       # forced tier A on a dirty node
             if not self.cfg.multilabel:
                 r["pred"] = int(np.argmax(r["scores"]))
+            self._lat[r["tier"]].observe(per_b_ms if r["tier"] == "B"
+                                         else per_a_ms)
             out.append(r)
         return out
 
     # -- delta ingestion --
+
+    def _mark_dirty_stamps(self, new_dirty: set):
+        """First-dirty timestamps for the refresh-lag figure (setdefault:
+        a node already waiting keeps its ORIGINAL staleness clock)."""
+        now = time.monotonic()
+        for n in new_dirty:
+            self._dirty_since.setdefault(n, now)
 
     def add_edges(self, edges: list) -> dict:
         pairs = [(int(u), int(v)) for u, v in edges]
@@ -520,11 +559,19 @@ class ServeCore:
             new_dirty = self.graph.forward_closure(touched, self.hops)
             added = new_dirty - self.dirty
             self.dirty |= new_dirty
+            self._mark_dirty_stamps(new_dirty)
             self.deltas.append({"op": "add_edges",
                                 "edges": [[u, v] for u, v in pairs]})
             self.stats["deltas"] += 1
-            return {"ok": True, "dirty_new": len(added),
-                    "dirty_total": len(self.dirty)}
+            out = {"ok": True, "dirty_new": len(added),
+                   "dirty_total": len(self.dirty)}
+        if self.obs is not None:
+            # OUTSIDE the core lock: a stalled telemetry write (slow/NFS
+            # log disk) must never block concurrent predicts behind a delta
+            self.obs.emit("delta", op="add_edges", edges=len(pairs),
+                          dirty_new=out["dirty_new"],
+                          dirty_total=out["dirty_total"])
+        return out
 
     def update_feat(self, node: int, vec) -> dict:
         with self._lock:
@@ -532,12 +579,18 @@ class ServeCore:
             new_dirty = self.graph.forward_closure(touched, self.hops)
             added = new_dirty - self.dirty
             self.dirty |= new_dirty
+            self._mark_dirty_stamps(new_dirty)
             self.deltas.append({"op": "update_feat", "node": int(node),
                                 "feat": np.asarray(
                                     vec, dtype=np.float32).tolist()})
             self.stats["deltas"] += 1
-            return {"ok": True, "dirty_new": len(added),
-                    "dirty_total": len(self.dirty)}
+            out = {"ok": True, "dirty_new": len(added),
+                   "dirty_total": len(self.dirty)}
+        if self.obs is not None:
+            self.obs.emit("delta", op="update_feat", node=int(node),
+                          dirty_new=out["dirty_new"],
+                          dirty_total=out["dirty_total"])
+        return out
 
     # -- incremental refresh --
 
@@ -614,6 +667,31 @@ class ServeCore:
             out["n_nodes"] = self.graph.n_nodes
             out["batches"] = self.batcher.batches
             out["batched_requests"] = self.batcher.batched_requests
+            # registry-backed figures (previously: counters only) — the
+            # per-tier latency percentiles serve_bench cross-checks its
+            # client-side numbers against, the current refresh lag (age of
+            # the stalest dirty row), and the batcher queue depth
+            now = time.monotonic()
+            out["refresh_lag_s"] = round(
+                now - min(self._dirty_since.values()), 6) \
+                if self._dirty_since else 0.0
+            out["queue_depth"] = len(self.batcher._pending)
+        for t in ("A", "B"):
+            snap = self._lat[t].snapshot()
+            out[f"tier_{t.lower()}_p50_ms"] = snap["p50"]
+            out[f"tier_{t.lower()}_p99_ms"] = snap["p99"]
+        lag = self._lag_hist.snapshot()
+        out["refresh_lag_p50_s"] = lag["p50"]
+        out["refresh_lag_p99_s"] = lag["p99"]
+        # mirror the headline gauges into the registry so the `metrics` op
+        # (full snapshot) always reports current depth/lag too. The gauge
+        # name differs from the 'serve/refresh_lag_s' HISTOGRAM on purpose:
+        # the gauge is the age of the stalest currently-dirty row, the
+        # histogram the per-row dirty->refreshed latency distribution
+        self.registry.gauge("serve/queue_depth").set(out["queue_depth"])
+        self.registry.gauge("serve/stalest_dirty_age_s").set(
+            out["refresh_lag_s"])
+        self.registry.gauge("serve/dirty").set(out["dirty"])
         return out
 
     def close(self):
@@ -647,7 +725,7 @@ class ServeServer:
     def _handle(self, req: dict) -> dict:
         op = req.get("op")
         with self._lock:
-            if self._draining and op not in ("ping", "stats"):
+            if self._draining and op not in ("ping", "stats", "metrics"):
                 return {"ok": False, "err": "draining"}
             self._inflight += 1
         try:
@@ -675,6 +753,12 @@ class ServeServer:
                 return {"ok": True, "refreshed": self.core.flush()}
             if op == "stats":
                 return {"ok": True, **self.core.snapshot_stats()}
+            if op == "metrics":
+                # the full registry snapshot (counters, gauges, histograms
+                # incl. per-tier p50/p90/p99) — the machine-readable twin
+                # of 'stats' for dashboards/scrapers
+                self.core.snapshot_stats()      # refresh the gauges first
+                return {"ok": True, "metrics": self.core.registry.snapshot()}
             if op == "shutdown":
                 self.shutdown_requested.set()
                 return {"ok": True}
@@ -718,7 +802,8 @@ def request(port: int, payload: dict, addr: str = "127.0.0.1",
 
 def build_core(cfg: Config, g: Graph, params, state, log=print,
                hidden: Optional[np.ndarray] = None,
-               logits: Optional[np.ndarray] = None) -> ServeCore:
+               logits: Optional[np.ndarray] = None,
+               obs: Optional[obs_mod.Obs] = None) -> ServeCore:
     """ServeCore over graph `g` with a precomputed (or supplied) table."""
     cfg = cfg.replace(n_feat=g.n_feat, n_class=g.n_class, n_train=g.n_train)
     spec = spec_from_config(cfg)
@@ -731,7 +816,7 @@ def build_core(cfg: Config, g: Graph, params, state, log=print,
             f"{time.perf_counter() - t0:.1f}s")
     return ServeCore(cfg, spec, DynamicGraph(g), params, state,
                      np.array(hidden, copy=True), np.array(logits, copy=True),
-                     log=log)
+                     log=log, obs=obs)
 
 
 def _load_model(cfg: Config, log) -> tuple:
@@ -764,6 +849,7 @@ def serve_main(argv=None) -> int:
     if not cfg.graph_name:
         cfg = cfg.replace(graph_name=cfg.derive_graph_name())
     log = print
+    obs = obs_mod.make_obs(cfg, rank=0, log=log)
     try:
         from bnsgcn_tpu.data.datasets import load_data
         g, _, _ = load_data(cfg)
@@ -778,7 +864,7 @@ def serve_main(argv=None) -> int:
                 + (f", exported at epoch {meta.get('epoch')}" if meta else "")
                 + ")")
         core = build_core(cfg, g, params, state, log=log,
-                          hidden=hidden, logits=logits)
+                          hidden=hidden, logits=logits, obs=obs)
     except ConfigError as ex:
         print(f"[config] {ex}", file=sys.stderr)
         sys.exit(2)
@@ -814,6 +900,10 @@ def serve_main(argv=None) -> int:
     log(f"[serve] ready on port {server.port}: tier A table lookup + tier B "
         f"{core.hops}-hop re-aggregation (max batch {cfg.serve_max_batch}), "
         f"delta log at {os.path.join(serve_dir, DELTA_LOG)}")
+    if obs is not None:
+        obs.emit("serve_header", port=server.port, n_nodes=core.graph.n_nodes,
+                 model=cfg.model, hops=core.hops,
+                 max_batch=cfg.serve_max_batch, replayed=replayed)
     try:
         while signals.requested is None:
             if server.shutdown_requested.wait(0.05):
@@ -828,6 +918,14 @@ def serve_main(argv=None) -> int:
             f"(A {stats['tier_a']} / B {stats['tier_b']}), "
             f"{stats['deltas']} delta(s) flushed to {path}, "
             f"{stats['dirty']} node(s) left dirty for the next run")
+        log(f"[serve] latency: tier A p50 {stats['tier_a_p50_ms']:.3f} ms / "
+            f"p99 {stats['tier_a_p99_ms']:.3f} ms | tier B p50 "
+            f"{stats['tier_b_p50_ms']:.3f} ms / p99 "
+            f"{stats['tier_b_p99_ms']:.3f} ms | refresh lag p50 "
+            f"{stats['refresh_lag_p50_s']:.3f} s")
+        if obs is not None:
+            obs.emit("serve_drain", **{k: stats[k] for k in sorted(stats)})
+            obs.close()
         signals.restore()
     if signals.requested is not None:
         log(f"[serve] {signals.requested} honored: resumable delta log "

@@ -1,0 +1,461 @@
+"""Unified telemetry bus (bnsgcn_tpu/obs.py) + its wiring.
+
+Unit level: the streaming histogram against known-quantile inputs (the
+fixed-log-bucket error bound), registry snapshots, event-log rotation bound
+and strict-JSON sanitization. Integration level: `--obs off` is pinned
+bitwise against `on` (the bus must never perturb training math), a real
+`--inject nan@..` CLI run leaves header + epoch + rollback + run_end events
+that tools/obs_report.py renders without error [quickgate], and a genuine
+2-process coordinated run produces rank 0's merged cross-rank epoch record
+(the agree_step piggyback — no extra collective) [quickgate]. Serving:
+`stats` carries registry-backed per-tier p50/p99 + refresh lag, and the
+`metrics` op serves the full registry snapshot.
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bnsgcn_tpu import obs as obs_mod
+from bnsgcn_tpu.config import Config, parse_config
+from bnsgcn_tpu.data.graph import sbm_graph
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------------
+# histogram / registry units
+# ----------------------------------------------------------------------------
+
+def test_histogram_known_quantiles():
+    """1..1000 observed in shuffled order: every quantile must land within
+    the documented bucket error bound (sqrt(growth) - 1 ~= 4.4% at the
+    default growth) of the exact order statistic."""
+    h = obs_mod.Histogram()
+    vals = np.arange(1, 1001, dtype=np.float64)
+    rng = np.random.default_rng(0)
+    rng.shuffle(vals)
+    for v in vals:
+        h.observe(float(v))
+    assert h.count == 1000
+    assert h.total == pytest.approx(float(vals.sum()))
+    assert h.vmin == 1.0 and h.vmax == 1000.0
+    for q, exact in ((50, 500.0), (90, 900.0), (99, 990.0)):
+        got = h.percentile(q)
+        assert abs(got - exact) <= 0.06 * exact, (q, got, exact)
+    snap = h.snapshot()
+    assert snap["count"] == 1000 and snap["max"] == 1000.0
+    assert snap["p50"] == pytest.approx(h.percentile(50))
+
+
+def test_histogram_empty_single_and_clamping():
+    h = obs_mod.Histogram()
+    assert h.percentile(50) == 0.0
+    assert h.snapshot()["count"] == 0
+    h.observe(3.7)
+    # a one-sample histogram must report the sample, not a bucket midpoint
+    # outside [vmin, vmax]
+    assert h.percentile(50) == pytest.approx(3.7)
+    assert h.percentile(99) == pytest.approx(3.7)
+    h2 = obs_mod.Histogram()
+    h2.observe(0.0)         # underflow bucket (below lo)
+    h2.observe(1e9)         # overflow bucket
+    h2.observe(float("nan"))    # non-finite: dropped, never a crash
+    h2.observe(float("inf"))
+    assert h2.count == 2
+    assert h2.percentile(1) == pytest.approx(0.0)
+    assert h2.percentile(99) == pytest.approx(1e9)
+
+
+def test_registry_snapshot_and_idempotent_instruments():
+    r = obs_mod.Registry()
+    c = r.counter("a/b")
+    c.inc()
+    c.inc(4)
+    assert r.counter("a/b") is c            # creation is idempotent
+    r.gauge("g").set(2.5)
+    r.histogram("h").observe(10.0)
+    snap = r.snapshot()
+    assert snap["counters"]["a/b"] == 5
+    assert snap["gauges"]["g"] == 2.5
+    assert snap["histograms"]["h"]["count"] == 1
+
+
+# ----------------------------------------------------------------------------
+# event log: rank tag, rotation bound, strict JSON
+# ----------------------------------------------------------------------------
+
+def test_eventlog_emit_and_load(tmp_path):
+    path = str(tmp_path / "obs.jsonl")
+    ev = obs_mod.EventLog(path, rank=3)
+    ev.emit("epoch", epoch=1, loss=0.5)
+    ev.emit("rollback", epoch=2, restart=1)
+    ev.close()
+    got = obs_mod.load_events(path)
+    assert [e["kind"] for e in got] == ["epoch", "rollback"]
+    assert all(e["rank"] == 3 and "ts" in e for e in got)
+
+
+def test_eventlog_rotation_bound(tmp_path):
+    """A size-capped log rotates once (PATH.1) and total disk stays bounded
+    at ~2x the cap no matter how many events land."""
+    path = str(tmp_path / "obs.jsonl")
+    ev = obs_mod.EventLog(path, max_bytes=2000)
+    for i in range(300):
+        ev.emit("epoch", epoch=i, loss=1.0 / (i + 1))
+    ev.close()
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    total = os.path.getsize(path) + os.path.getsize(path + ".1")
+    assert total <= 2 * 2000 + 200      # one event of slack per file
+    # both generations parse, and load_events stitches them oldest-first
+    got = obs_mod.load_events(path)
+    assert len(got) >= 2
+    assert got[0]["epoch"] < got[-1]["epoch"]
+
+
+def test_eventlog_nan_is_strict_json(tmp_path):
+    """The rollback event's whole point is recording a NaN loss — the line
+    must still parse under a STRICT reader (no bare NaN token)."""
+    path = str(tmp_path / "obs.jsonl")
+    ev = obs_mod.EventLog(path)
+    ev.emit("rollback", loss=float("nan"), inf=float("inf"),
+            nested={"v": float("nan")})
+    ev.close()
+    line = open(path).read().strip()
+
+    def no_const(_):
+        raise AssertionError("non-strict JSON constant in event line")
+
+    rec = json.loads(line, parse_constant=no_const)
+    assert rec["loss"] == "nan" and rec["nested"]["v"] == "nan"
+
+
+def test_rank_log_path_and_make_obs(tmp_path):
+    assert obs_mod.rank_log_path("/x/o.jsonl", 0) == "/x/o.jsonl"
+    assert obs_mod.rank_log_path("/x/o.jsonl", 2) == "/x/o.jsonl.r2"
+    cfg = Config(obs="off", obs_log=str(tmp_path / "o.jsonl"))
+    assert obs_mod.make_obs(cfg, log=lambda *a: None) is None
+    cfg = Config(obs="on", obs_log=str(tmp_path / "o.jsonl"))
+    obs = obs_mod.make_obs(cfg, rank=1, log=lambda *a: None)
+    obs.emit("x")
+    obs.close()
+    assert os.path.exists(str(tmp_path / "o.jsonl.r1"))
+
+
+def test_obs_report_renders_nan_sanitized_records(tmp_path):
+    """A --resilience off diverged run logs epoch records with loss "nan"
+    (the strict-JSON sanitization); the report tool must render — not
+    crash on — exactly the log it exists to triage."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    path = str(tmp_path / "div.jsonl")
+    ev = obs_mod.EventLog(path)
+    for e in range(3):
+        ev.emit("epoch", epoch=e, loss=float("nan") if e else 1.2,
+                step_s=0.01, comm_s=float("nan"), comm_tag="sampled")
+    ev.emit("eval", epoch=2, val_acc=float("nan"))
+    ev.close()
+    s = obs_report.summarize(obs_report.load_run([path]))
+    lines = []
+    obs_report.render(s, write=lines.append)
+    assert any("nan" in ln for ln in lines)
+    obs_report.compare(s, s, path, path, write=lines.append)
+
+
+def test_write_postmortem_failure_returns_empty():
+    """An unwritable post-mortem dir returns "" (no breadcrumb to a ghost
+    file) instead of a path that was never written."""
+    assert obs_mod.write_postmortem("/proc/nonexistent/pm", "t") == ""
+
+
+def test_eventlog_unwritable_path_degrades_not_raises(capsys):
+    """An unwritable $BNSGCN_OBS_LOG must degrade to a no-log run at
+    construction — never crash-loop a watchdog5 relaunch before training."""
+    ev = obs_mod.EventLog("/proc/nonexistent/obs.jsonl")
+    ev.emit("epoch", epoch=0)       # no-op, no raise
+    ev.close()
+    assert "telemetry log disabled" in capsys.readouterr().err
+
+
+def test_eventlog_bad_max_mb_env_degrades(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("BNSGCN_OBS_MAX_MB", "64MB")
+    ev = obs_mod.EventLog(str(tmp_path / "o.jsonl"))
+    assert ev.max_bytes == 64 * 2 ** 20
+    ev.emit("x")
+    ev.close()
+    assert "bad $BNSGCN_OBS_MAX_MB" in capsys.readouterr().err
+
+
+def test_eventlog_emit_bounded_skips_on_held_lock(tmp_path):
+    """The watchdog's exit-path emit must give up on a held writer lock
+    (a disk-stalled main thread inside emit) instead of deadlocking the
+    os._exit(77) escape hatch."""
+    ev = obs_mod.EventLog(str(tmp_path / "o.jsonl"))
+    ev.emit("a")
+    assert ev._lock.acquire()       # simulate a stalled writer holding it
+    try:
+        t0 = __import__("time").monotonic()
+        ev.emit_bounded("watchdog_fire", timeout_s=0.2)
+        assert __import__("time").monotonic() - t0 < 2.0
+    finally:
+        ev._lock.release()
+    ev.emit_bounded("b")            # lock free again: this one lands
+    ev.close()
+    kinds = [e["kind"] for e in obs_mod.load_events(str(tmp_path / "o.jsonl"))]
+    assert kinds == ["a", "b"]      # the blocked emit was skipped, not queued
+
+
+def test_write_postmortem(tmp_path):
+    r = obs_mod.Registry()
+    r.counter("c").inc()
+    path = obs_mod.write_postmortem(str(tmp_path / "pm"), "watchdog_E3",
+                                    text="hung", registry=r)
+    body = open(path).read()
+    assert "hung" in body and "all-thread stacks" in body
+    metrics = path.replace(".txt", "_metrics.json")
+    assert json.load(open(metrics))["counters"]["c"] == 1
+
+
+def test_cli_obs_flags_parse():
+    cfg = parse_config(["--obs", "off", "--obs-log", "/tmp/x.jsonl",
+                        "--obs-dir", "/tmp/pm"])
+    assert (cfg.obs, cfg.obs_log, cfg.obs_dir) == ("off", "/tmp/x.jsonl",
+                                                   "/tmp/pm")
+    assert parse_config([]).obs == "on"
+
+
+# ----------------------------------------------------------------------------
+# --obs off == on, bitwise (the bus must never touch training math)
+# ----------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def small_graph():
+    return sbm_graph(n_nodes=240, n_class=3, n_feat=8, p_in=0.12, p_out=0.01,
+                     seed=3)
+
+
+def _base_cfg(tmp_path, **kw):
+    d = dict(dataset="sbm", model="graphsage", n_partitions=2, n_layers=2,
+             n_hidden=8, sampling_rate=0.5, dropout=0.5, use_pp=True,
+             eval=False, n_epochs=8, log_every=2, seed=7, comm_trace=False,
+             part_path=str(tmp_path / "parts"),
+             ckpt_path=str(tmp_path / "ckpt"),
+             results_path=str(tmp_path / "res"))
+    d.update(kw)
+    return Config(**d)
+
+
+def test_obs_off_bitwise_identical_to_on(tmp_path, small_graph):
+    from bnsgcn_tpu.run import run_training
+    r_off = run_training(
+        _base_cfg(tmp_path, obs="off", ckpt_path=str(tmp_path / "c0")),
+        g=small_graph, verbose=False)
+    r_on = run_training(
+        _base_cfg(tmp_path, obs="on",
+                  obs_log=str(tmp_path / "obs.jsonl"),
+                  ckpt_path=str(tmp_path / "c1")),
+        g=small_graph, verbose=False)
+    np.testing.assert_array_equal(r_off.losses, r_on.losses)
+    assert r_off.final_loss == r_on.final_loss
+    # and the on-run actually recorded its trail
+    kinds = {e["kind"] for e in
+             obs_mod.load_events(str(tmp_path / "obs.jsonl"))}
+    assert {"run_header", "epoch", "run_end"} <= kinds
+
+
+def test_rollback_run_leaves_lifecycle_trail(tmp_path, small_graph,
+                                             monkeypatch):
+    """In-process: a nan@E5 divergence leaves inject + rollback events whose
+    fields match the RunResult, and the header records the resolved mesh."""
+    monkeypatch.setenv("BNSGCN_RETRY_BACKOFF_S", "0")
+    from bnsgcn_tpu.run import run_training
+    log = str(tmp_path / "obs.jsonl")
+    res = run_training(_base_cfg(tmp_path, obs_log=log, inject="nan@E5"),
+                       g=small_graph, verbose=False)
+    evs = obs_mod.load_events(log)
+    kinds = [e["kind"] for e in evs]
+    assert kinds.count("run_header") == 1 and "run_end" in kinds
+    hdr = next(e for e in evs if e["kind"] == "run_header")
+    assert hdr["parts"] == 2 and hdr["config"]["model"] == "graphsage"
+    assert hdr["wire_mb_per_exchange"] > 0
+    rb = [e for e in evs if e["kind"] == "rollback"]
+    assert len(rb) == len(res.rollbacks) == 1
+    assert rb[0]["epoch"] == 5 and rb[0]["nonce"] == 1
+    assert rb[0]["loss"] == "nan"       # sanitized, not a bare NaN token
+    inj = [e for e in evs if e["kind"] == "inject"]
+    assert inj and inj[0]["kind_injected"] == "nan"
+    # per-epoch records cover every EXECUTED epoch: the diverged epoch-5
+    # pass rolls back before its record (no poisoned row), and the restart
+    # epoch (4, from the epoch-3 checkpoint) is recorded twice
+    eps = [e["epoch"] for e in evs if e["kind"] == "epoch"]
+    assert eps.count(4) == 2 and eps.count(5) == 1
+    assert max(eps) == 7
+
+
+# ----------------------------------------------------------------------------
+# e2e through the real CLI (the artifact the ROADMAP campaigns audit)
+# ----------------------------------------------------------------------------
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               BNSGCN_RETRY_BACKOFF_S="0", BNSGCN_COORD_TIMEOUT_S="60",
+               PYTHONPATH=REPO)
+    env.update(extra or {})
+    return env
+
+
+BASE_ARGS = [
+    "--dataset", "sbm", "--partition-method", "random", "--n-partitions", "2",
+    "--model", "graphsage", "--n-layers", "2", "--n-hidden", "8",
+    "--sampling-rate", "0.5", "--use-pp", "--n-epochs", "8",
+    "--log-every", "2", "--no-eval", "--no-comm-trace",
+    "--fix-seed", "--seed", "11",
+]
+
+
+@pytest.mark.quickgate
+def test_cli_obs_e2e_and_report(tmp_path):
+    """A real `--inject nan@E5` CLI run produces a parseable JSONL log with
+    header + epoch + rollback + run_end, and tools/obs_report.py renders it
+    without error."""
+    log = str(tmp_path / "obs.jsonl")
+    r = subprocess.run(
+        [sys.executable, "-m", "bnsgcn_tpu.main"] + BASE_ARGS
+        + ["--part-path", str(tmp_path / "parts"),
+           "--ckpt-path", str(tmp_path / "ckpt"),
+           "--results-path", str(tmp_path / "res"),
+           "--inject", "nan@E5", "--obs-log", log],
+        capture_output=True, text=True, timeout=240, cwd=REPO, env=_env())
+    assert r.returncode == 0, r.stdout + r.stderr
+    kinds = [e["kind"] for e in obs_mod.load_events(log)]
+    for want in ("run_header", "epoch", "inject", "rollback", "run_end"):
+        assert want in kinds, (want, kinds)
+    rep = subprocess.run(
+        [sys.executable, "tools/obs_report.py", log],
+        capture_output=True, text=True, timeout=60, cwd=REPO, env=_env())
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    assert "rollback" in rep.stdout and "per-epoch" in rep.stdout
+    # --compare against itself must also render (the bench-window audit path)
+    cmp_ = subprocess.run(
+        [sys.executable, "tools/obs_report.py", "--compare", log, log],
+        capture_output=True, text=True, timeout=60, cwd=REPO, env=_env())
+    assert cmp_.returncode == 0, cmp_.stdout + cmp_.stderr
+    assert "mean step" in cmp_.stdout
+
+
+@pytest.mark.quickgate
+def test_two_rank_merged_epoch_record(tmp_path):
+    """2 real coordinated processes (the PR-5 harness): each rank's epoch
+    summary piggybacks on agree_step's verdict value, and rank 0's log holds
+    ONE merged `epoch_ranks` record per epoch naming both ranks — no new
+    collective existed for this (pinned by the coord suite's lockstep seq
+    accounting staying green)."""
+    subprocess.run(
+        [sys.executable, "-m", "bnsgcn_tpu.partition_cli",
+         "--dataset", "sbm", "--partition-method", "random",
+         "--n-partitions", "2", "--fix-seed",
+         "--part-path", str(tmp_path / "parts")],
+        env=_env(), check=True, capture_output=True, cwd=REPO)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    log = str(tmp_path / "obs.jsonl")
+    procs = []
+    for rank in (0, 1):
+        cmd = ([sys.executable, "-m", "bnsgcn_tpu.main"] + BASE_ARGS
+               + ["--skip-partition", "--n-epochs", "6",
+                  "--part-path", str(tmp_path / "parts"),
+                  "--ckpt-path", str(tmp_path / f"ck{rank}"),
+                  "--results-path", str(tmp_path / "res"),
+                  "--coord", "tcp", "--coord-port", str(port),
+                  "--coord-world", "2", "--coord-rank", str(rank),
+                  "--obs-log", log])
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True,
+                                      cwd=REPO, env=_env()))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    assert [rc for rc, _ in outs] == [0, 0], outs
+    # rank 0 owns the bare path; rank 1 wrote its own .r1 sibling
+    ev0 = obs_mod.load_events(log)
+    merged = [e for e in ev0 if e["kind"] == "epoch_ranks"]
+    assert merged, [e["kind"] for e in ev0]
+    for rec in merged:
+        assert set(rec["ranks"]) == {"0", "1"}
+        for info in rec["ranks"].values():
+            assert "loss" in info and "step_ms" in info
+    # exactly one merged record per executed epoch, all on rank 0
+    assert sorted(rec["epoch"] for rec in merged) == list(range(6))
+    assert all(rec["rank"] == 0 for rec in merged)
+    ev1 = obs_mod.load_events(log + ".r1")
+    assert any(e["kind"] == "epoch" and e["rank"] == 1 for e in ev1)
+    assert not any(e["kind"] == "epoch_ranks" for e in ev1)
+
+
+# ----------------------------------------------------------------------------
+# serving: registry-backed stats + the metrics op
+# ----------------------------------------------------------------------------
+
+def test_serve_stats_percentiles_and_metrics_op():
+    import jax
+
+    from bnsgcn_tpu import serve
+    from bnsgcn_tpu.models.gnn import init_params, spec_from_config
+    g = sbm_graph(n_nodes=120, n_class=3, n_feat=8, p_in=0.12, p_out=0.01,
+                  seed=3)
+    cfg = Config(dataset="sbm", model="graphsage", n_layers=2, n_hidden=8,
+                 use_pp=True, n_feat=g.n_feat, n_class=g.n_class,
+                 n_train=g.n_train)
+    spec = spec_from_config(cfg)
+    params, state = init_params(jax.random.key(0), spec)
+    core = serve.build_core(cfg, g, params, state, log=lambda *a: None)
+    try:
+        for n in (1, 2, 3):
+            core.predict(n)                 # tier A
+        core.add_edges([[0, 1]])
+        core.predict(1)                     # dirty -> tier B
+        core.flush()
+        st = core.snapshot_stats()
+        # previously counters only; now registry-backed latency + lag
+        assert st["tier_a_p50_ms"] > 0 and st["tier_a_p99_ms"] > 0
+        assert st["tier_b_p50_ms"] > 0
+        assert st["tier_b_p99_ms"] >= st["tier_b_p50_ms"]
+        assert st["refresh_lag_p50_s"] > 0  # the flushed dirty row's age
+        assert st["refresh_lag_s"] == 0.0   # nothing left dirty
+        assert st["queue_depth"] == 0
+        # old counter vocabulary intact (BENCH/serve_bench compatibility)
+        assert st["requests"] == 4 and st["tier_b"] == 1
+        server = serve.ServeServer(core, port=0, log=lambda *a: None)
+        try:
+            m = server._handle({"op": "metrics"})
+            assert m["ok"]
+            hists = m["metrics"]["histograms"]
+            assert hists["serve/latency_ms/A"]["count"] == 3
+            assert hists["serve/latency_ms/B"]["count"] == 1
+            assert hists["serve/refresh_lag_s"]["count"] >= 1
+            assert m["metrics"]["gauges"]["serve/dirty"] == 0
+            s2 = server._handle({"op": "stats"})
+            assert s2["ok"] and s2["tier_b_p99_ms"] > 0
+        finally:
+            server.drain(timeout_s=5.0)
+    finally:
+        core.close()

@@ -44,23 +44,41 @@ check() {  # check <name> <want_rc> <got_rc>
   fi
 }
 
+# every stage also leaves an obs telemetry log (bnsgcn_tpu/obs.py) and must
+# have recorded the MATCHING lifecycle event — the machine-readable twin of
+# the stderr lines the greps below pin
+check_event() {  # check_event <stage> <obs_log> <kind>
+  if grep -q "\"kind\": \"$3\"" "$2" 2>/dev/null; then
+    echo "PASS  $1 obs event '$3'"
+  else
+    echo "FAIL  $1: no '$3' event in obs log $2"
+    FAIL=1
+  fi
+}
+
 echo "== uninterrupted reference run =="
 python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_ref" \
-  > "$WORK/ref.log" 2>&1
+  --obs-log "$WORK/obs_ref.jsonl" > "$WORK/ref.log" 2>&1
 check ref 0 $?
 REF_LOSS=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/ref.log" | cut -d= -f2)
+check_event ref "$WORK/obs_ref.jsonl" run_header
+check_event ref "$WORK/obs_ref.jsonl" epoch
+check_event ref "$WORK/obs_ref.jsonl" run_end
 
 echo "== nan@E5: divergence rollback, run completes =="
 python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_nan" \
-  --inject nan@E5 > "$WORK/nan.log" 2>&1
+  --obs-log "$WORK/obs_nan.jsonl" --inject nan@E5 > "$WORK/nan.log" 2>&1
 check nan 0 $?
 grep -q 'rolled back to' "$WORK/nan.log" \
   || { echo "FAIL  nan: no rollback line"; FAIL=1; }
+check_event nan "$WORK/obs_nan.jsonl" rollback
 
 echo "== sigterm@E3: resumable exit 75, then --resume matches ref =="
 python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_sig" \
-  --inject sigterm@E3 > "$WORK/sigterm.log" 2>&1
+  --obs-log "$WORK/obs_sig.jsonl" --inject sigterm@E3 \
+  > "$WORK/sigterm.log" 2>&1
 check sigterm 75 $?
+check_event sigterm "$WORK/obs_sig.jsonl" preempt
 python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_sig" \
   --resume --skip-partition --seed 999 > "$WORK/resume.log" 2>&1
 check resume 0 $?
@@ -83,10 +101,13 @@ echo "== hang@E3: watchdog stack dump + exit 77 =="
 BNSGCN_WATCHDOG_MIN_S=1.5 BNSGCN_WATCHDOG_FACTOR=2 \
   BNSGCN_WATCHDOG_GRACE_S=120 \
   python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_hang" \
-  --inject hang@E3 > "$WORK/hang.log" 2>&1
+  --obs-log "$WORK/obs_hang.jsonl" --inject hang@E3 > "$WORK/hang.log" 2>&1
 check hang 77 $?
 grep -q 'watchdog' "$WORK/hang.log" \
   || { echo "FAIL  hang: no watchdog dump"; FAIL=1; }
+check_event hang "$WORK/obs_hang.jsonl" watchdog_fire
+grep -q 'post-mortem dump' "$WORK/hang.log" \
+  || { echo "FAIL  hang: no post-mortem dump path on stderr"; FAIL=1; }
 
 # ---- multi-host stages: two real coordinated rank processes. The
 # coordinator is XLA-free, so these run on the CPU container where jaxlib
@@ -131,9 +152,13 @@ for r in 0 1; do
 done
 
 echo "== multi-host: nan@E5 on rank 0 -> coordinated rollback, same nonce =="
-run_pair mh_nan "$WORK/ck_mhn" "$WORK/ck_mhn" --inject nan@E5:r0
+run_pair mh_nan "$WORK/ck_mhn" "$WORK/ck_mhn" --inject nan@E5:r0 \
+  --obs-log "$WORK/obs_mh_nan.jsonl"
 check mh_nan_r0 0 $RC0
 check mh_nan_r1 0 $RC1
+check_event mh_nan "$WORK/obs_mh_nan.jsonl" epoch_ranks
+check_event mh_nan "$WORK/obs_mh_nan.jsonl" rollback
+check_event mh_nan_r1 "$WORK/obs_mh_nan.jsonl.r1" rollback
 grep -q 'agreed rollback to' "$WORK/mh_nan_r0.log" \
   || { echo "FAIL  mh_nan: rank 0 did not decide a rollback"; FAIL=1; }
 grep -q 'agreed rollback (decided by rank 0)' "$WORK/mh_nan_r1.log" \

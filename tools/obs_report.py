@@ -1,0 +1,368 @@
+"""Render a bnsgcn_tpu obs event log (--obs-log JSONL) as a human report.
+
+The telemetry bus (bnsgcn_tpu/obs.py) leaves one machine-readable artifact
+per run: a rank-tagged JSONL event log. This tool answers "where did the
+time/bytes go, on which rank, in which epoch" AFTER the run — including
+after the hardware tunnel window closed:
+
+  python tools/obs_report.py RUN.jsonl              # one-run report
+  python tools/obs_report.py RUN.jsonl R1.jsonl ... # explicit multi-rank merge
+  python tools/obs_report.py --compare A.jsonl B.jsonl   # trajectory diff
+  python tools/obs_report.py RUN.jsonl --json       # summary as one JSON line
+
+Sections (each rendered only when the log carries its events):
+  * run header — config, RxPxT mesh, halo strategy/wire, partition stats
+  * per-epoch table — loss, step ms, comm ms ([traced]/[sampled]), param
+    norm, eval accuracy joined on epoch; multi-rank logs merge per rank
+    (rank files `PATH.r<N>` are auto-discovered next to PATH)
+  * comm-vs-compute split — per-epoch means from the epoch records; when a
+    `trace`/`profile` event names a still-existing trace dir, the split is
+    re-derived from the device spans via utils/traceparse (the ground truth)
+  * lifecycle — rollbacks, preemptions, injections, watchdog fires,
+    coordinator decisions, post-mortem dump paths (exits 75/76/77/78)
+  * cross-rank epochs — rank 0's merged `epoch_ranks` records (the
+    piggybacked agree_step summaries)
+  * serving — per-tier p50/p99 + refresh lag from `serve_drain`
+  * bench — per-variant epoch times from a bench.py --obs-log
+
+--compare prints an epoch-aligned loss/step diff plus the header deltas —
+the bench-trajectory audit for hardware-window runs (bench.py records each
+run's obs-log path in its result JSON).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bnsgcn_tpu.obs import load_events  # noqa: E402  (stdlib-only import)
+
+LIFECYCLE_KINDS = ("inject", "rollback", "preempt", "watchdog_fire",
+                   "divergence_abort", "coord_decision", "profile_request",
+                   "profile")
+
+
+def load_run(paths: list[str]) -> list[dict]:
+    """Events of one run, merged across the given files plus any auto-
+    discovered per-rank siblings (`PATH.r<N>`), sorted by timestamp."""
+    seen = []
+    for p in paths:
+        seen.append(p)
+        # rank siblings only (PATH.r<digits>): PATH.r1.1 is rank 1's
+        # ROTATION, which load_events already prepends when reading PATH.r1
+        # — globbing it as a primary path would double-count its events
+        seen.extend(sorted(
+            m for m in glob.glob(glob.escape(p) + ".r*")
+            if re.fullmatch(r"\.r\d+", m[len(p):])))
+    events: list[dict] = []
+    for p in dict.fromkeys(seen):       # de-dup, keep order
+        if not os.path.exists(p):
+            raise FileNotFoundError(f"no obs log at {p}")
+        events.extend(load_events(p))
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events
+
+
+def summarize(events: list[dict]) -> dict:
+    """Structured digest of one run's events (the --json output)."""
+    out: dict = {"header": None, "epochs": {}, "evals": {}, "lifecycle": [],
+                 "epoch_ranks": [], "serve": None, "serve_header": None,
+                 "run_end": None, "traces": [], "bench": []}
+    for ev in events:
+        k = ev.get("kind")
+        if k == "run_header" and out["header"] is None:
+            out["header"] = ev
+        elif k == "epoch":
+            out["epochs"].setdefault(int(ev["epoch"]), {})[
+                int(ev.get("rank", 0))] = ev
+        elif k == "eval":
+            out["evals"][int(ev["epoch"])] = ev
+        elif k in LIFECYCLE_KINDS:
+            out["lifecycle"].append(ev)
+        elif k == "epoch_ranks":
+            out["epoch_ranks"].append(ev)
+        elif k == "serve_drain":
+            out["serve"] = ev
+        elif k == "serve_header":
+            out["serve_header"] = ev
+        elif k == "run_end" and int(ev.get("rank", 0)) == 0:
+            out["run_end"] = ev
+        elif k == "trace":
+            out["traces"].append(ev)
+        elif k == "bench_variant":
+            out["bench"].append(ev)
+    return out
+
+
+def _num(v) -> float:
+    """Event numbers may arrive NaN-sanitized as strings ("nan"/"inf" —
+    obs._sanitize keeps every line strict JSON); a diverged-run log is
+    exactly what this tool must render, so coerce instead of crashing."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str):
+        try:
+            return float(v)
+        except ValueError:
+            return float("nan")
+    return float("nan")
+
+
+def _mean(xs):
+    xs = [_num(x) for x in xs if x is not None]
+    xs = [x for x in xs if math.isfinite(x)]
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+def _elide(rows, head=20, tail=15):
+    if len(rows) <= head + tail + 1:
+        return rows, False
+    return rows[:head] + rows[-tail:], True
+
+
+def render(s: dict, write=print):
+    hdr = s["header"]
+    if hdr is not None:
+        cfg = hdr.get("config", {})
+        write(f"run: {cfg.get('dataset', '?')} {cfg.get('model', '?')} "
+              f"L={cfg.get('n_layers', '?')} H={cfg.get('n_hidden', '?')} "
+              f"rate={cfg.get('sampling_rate', '?')} "
+              f"seed={cfg.get('seed', '?')}")
+        write(f"mesh: {hdr.get('mesh')} ({hdr.get('replicas')}x"
+              f"{hdr.get('parts')}x{hdr.get('feat')} replicas x parts x "
+              f"feat) | halo {hdr.get('halo')}/{hdr.get('wire')}: "
+              f"{hdr.get('wire_mb_per_exchange')} MB/exchange/device")
+        part = hdr.get("partition") or {}
+        if part:
+            write("partition: " + " ".join(f"{k}={v}"
+                                           for k, v in sorted(part.items())))
+    epochs = s["epochs"]
+    if epochs:
+        ranks = sorted({r for by_r in epochs.values() for r in by_r})
+        multi = len(ranks) > 1
+        write("")
+        write("per-epoch" + (f" (ranks {ranks})" if multi else "") + ":")
+        cols = ("  epoch   loss        step_ms   comm_ms[t=traced,"
+                "s=sampled]  param_norm  eval")
+        write(cols + ("  rank" if multi else ""))
+        rows = []
+        for e in sorted(epochs):
+            for r in sorted(epochs[e]):
+                ev = epochs[e][r]
+                ez = s["evals"].get(e, {})
+                acc = next((v for k, v in ez.items() if k.endswith("_acc")),
+                           None)
+                comm = ev.get("comm_s")
+                rows.append(
+                    f"  {e:5d}   {_num(ev.get('loss')):<9.4f}  "
+                    f"{_num(ev.get('step_s', 0.0)) * 1e3:8.2f}  "
+                    + (f"{_num(comm) * 1e3:7.2f}"
+                       f"[{ev.get('comm_tag', '?')[:1]}]{'':<15}"
+                       if comm is not None else f"{'-':>9}{'':<17}")
+                    + f"  {ev.get('param_norm', ''):<10}  "
+                    + (f"{_num(acc):.4f}" if acc is not None else "-")
+                    + (f"     r{r}" if multi else ""))
+        rows, elided = _elide(rows)
+        for row in rows:
+            write(row)
+        if elided:
+            write(f"  ... ({len(epochs)} epochs total; middle elided)")
+        # comm vs compute (the first recorded epoch carries the XLA compile
+        # and would dominate a raw mean — drop it when there is more data)
+        es = sorted(epochs)
+        body = es[1:] if len(es) > 3 else es
+        steps = [ev.get("step_s") for e in body
+                 for ev in epochs[e].values()]
+        comms = [ev.get("comm_s") for e in body for ev in epochs[e].values()
+                 if ev.get("comm_tag") == "traced"]
+        tag = "traced"
+        if not comms:
+            comms = [ev.get("comm_s") for e in body
+                     for ev in epochs[e].values()
+                     if ev.get("comm_s") is not None]
+            tag = "sampled"
+        mt, mc = _mean(steps), _mean(comms)
+        write("")
+        write(f"comm vs compute (excl. compile epoch): step {mt * 1e3:.2f} "
+              f"ms | comm [{tag}] {mc * 1e3:.2f} ms"
+              + (f" ({mc / mt:.0%} of step)" if mt > 0 else ""))
+    for tr in s["traces"]:
+        td = tr.get("trace_dir")
+        line = (f"trace @E{tr.get('epoch')}: comm {tr.get('comm_s', 0) * 1e3:.2f} ms "
+                f"reduce {tr.get('reduce_s', 0) * 1e3:.2f} ms per step")
+        if td and os.path.isdir(td):
+            # the trace still exists: re-derive the split from device spans
+            try:
+                from bnsgcn_tpu.utils import traceparse
+                parsed = traceparse.step_comm_per_epoch(td)
+                if parsed is not None:
+                    line += (f" | re-parsed from {td}: exchange "
+                             f"{parsed[0] * 1e3:.2f} ms reduce "
+                             f"{parsed[1] * 1e3:.2f} ms over {parsed[2]} steps")
+            except Exception:
+                pass
+        write(line)
+    if s["lifecycle"]:
+        write("")
+        write("lifecycle:")
+        for ev in s["lifecycle"]:
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("ts", "kind", "rank")}
+            write(f"  r{ev.get('rank', 0)} {ev['kind']}: "
+                  + " ".join(f"{k}={v}" for k, v in sorted(extra.items())))
+    if s["epoch_ranks"]:
+        write("")
+        write(f"cross-rank epochs (merged by rank 0, "
+              f"{len(s['epoch_ranks'])} records):")
+        rows = []
+        for ev in s["epoch_ranks"]:
+            ranks = ev.get("ranks", {})
+            rows.append(f"  E{ev.get('epoch'):5d} [{ev.get('decision')}] "
+                        + " | ".join(
+                            f"r{r}: loss {i.get('loss')} "
+                            f"step {i.get('step_ms')} ms"
+                            # numeric sort: JSON keys are strings, and a
+                            # world >= 10 must not render r10 before r2
+                            for r, i in sorted(
+                                ranks.items(),
+                                key=lambda kv: (not kv[0].isdigit(),
+                                                int(kv[0])
+                                                if kv[0].isdigit()
+                                                else kv[0]))))
+        rows, elided = _elide(rows)
+        for row in rows:
+            write(row)
+        if elided:
+            write("  ...")
+    if s["serve"] is not None:
+        sv = s["serve"]
+        write("")
+        write("serving:")
+        write(f"  {sv.get('requests')} requests (A {sv.get('tier_a')} / B "
+              f"{sv.get('tier_b')}), {sv.get('deltas')} deltas, "
+              f"{sv.get('refreshed_nodes')} rows refreshed")
+        write(f"  tier A p50 {sv.get('tier_a_p50_ms')} ms p99 "
+              f"{sv.get('tier_a_p99_ms')} ms | tier B p50 "
+              f"{sv.get('tier_b_p50_ms')} ms p99 {sv.get('tier_b_p99_ms')} ms")
+        write(f"  refresh lag p50 {sv.get('refresh_lag_p50_s')} s p99 "
+              f"{sv.get('refresh_lag_p99_s')} s")
+    if s["bench"]:
+        write("")
+        write("bench variants:")
+        for ev in s["bench"]:
+            write(f"  {ev.get('name'):<32} {ev.get('epoch_s')} s/epoch "
+                  f"(min {ev.get('min_epoch_s')}) loss {ev.get('loss')} "
+                  f"[{ev.get('backend')}]")
+    end = s["run_end"]
+    if end is not None:
+        write("")
+        if "interrupted" in end:
+            write(f"run INTERRUPTED by {end['interrupted']} after "
+                  f"{end.get('epochs_done')} epochs (final loss "
+                  f"{end.get('final_loss')})")
+        else:
+            write(f"run end: epoch {end.get('epoch_time_s')} s | final loss "
+                  f"{end.get('final_loss')} | best val "
+                  f"{end.get('best_val_acc')} | test {end.get('test_acc')} | "
+                  f"{end.get('rollbacks')} rollback(s)")
+
+
+def compare(sa: dict, sb: dict, name_a: str, name_b: str, write=print):
+    """Epoch-aligned trajectory diff: the bench-window audit."""
+    write(f"compare: A = {name_a}")
+    write(f"         B = {name_b}")
+    for tag, s in (("A", sa), ("B", sb)):
+        hdr = s["header"] or {}
+        cfg = hdr.get("config", {})
+        write(f"  {tag}: {cfg.get('model', '?')} spmm={cfg.get('spmm', '?')} "
+              f"halo={hdr.get('halo', '?')}/{hdr.get('wire', '?')} mesh="
+              f"{hdr.get('mesh', '?')} wire_mb={hdr.get('wire_mb_per_exchange')}")
+    if sa["bench"] or sb["bench"]:
+        by = {}
+        for tag, s in (("a", sa), ("b", sb)):
+            for ev in s["bench"]:
+                by.setdefault(ev.get("name"), {})[tag] = ev
+        write("")
+        write("  variant                          A s/epoch   B s/epoch   B/A")
+        for name in sorted(by):
+            a, b = by[name].get("a"), by[name].get("b")
+            ea = a.get("epoch_s") if a else None
+            eb = b.get("epoch_s") if b else None
+            ratio = (f"{eb / ea:.3f}" if ea and eb else "-")
+            write(f"  {name:<32} {ea if ea is not None else '-':>9}   "
+                  f"{eb if eb is not None else '-':>9}   {ratio}")
+    ea = {e: list(r.values())[0] for e, r in sa["epochs"].items()}
+    eb = {e: list(r.values())[0] for e, r in sb["epochs"].items()}
+    shared = sorted(set(ea) & set(eb))
+    if shared:
+        write("")
+        write("  epoch   loss_A     loss_B     dloss      step_A_ms  step_B_ms")
+        rows = []
+        for e in shared:
+            la, lb = _num(ea[e].get("loss")), _num(eb[e].get("loss"))
+            rows.append(f"  {e:5d}   {la:<9.4f}  {lb:<9.4f}  "
+                        f"{(lb - la):+9.4f}  "
+                        f"{_num(ea[e].get('step_s', 0)) * 1e3:9.2f}  "
+                        f"{_num(eb[e].get('step_s', 0)) * 1e3:9.2f}")
+        rows, elided = _elide(rows)
+        for row in rows:
+            write(row)
+        if elided:
+            write(f"  ... ({len(shared)} shared epochs; middle elided)")
+        body = shared[1:] if len(shared) > 3 else shared   # drop compile epoch
+        ma = _mean([ea[e].get("step_s") for e in body])
+        mb = _mean([eb[e].get("step_s") for e in body])
+        write(f"  mean step (excl. compile epoch): A {ma * 1e3:.2f} ms | "
+              f"B {mb * 1e3:.2f} ms"
+              + (f" | B/A {mb / ma:.3f}" if ma > 0 else ""))
+    for tag, s in (("A", sa), ("B", sb)):
+        end = s["run_end"] or {}
+        if end:
+            write(f"  {tag} end: final loss {end.get('final_loss')} "
+                  f"epoch {end.get('epoch_time_s')} s "
+                  + (f"(interrupted: {end['interrupted']})"
+                     if "interrupted" in end else ""))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("logs", nargs="*", help="obs JSONL log(s) of ONE run "
+                   "(rank siblings PATH.r<N> auto-discovered)")
+    p.add_argument("--compare", nargs=2, metavar=("RUN_A", "RUN_B"),
+                   help="diff two runs' logs epoch-by-epoch instead")
+    p.add_argument("--json", action="store_true",
+                   help="emit the structured summary as one JSON line")
+    args = p.parse_args(argv)
+    if args.compare:
+        sa = summarize(load_run([args.compare[0]]))
+        sb = summarize(load_run([args.compare[1]]))
+        if args.json:
+            print(json.dumps({"a": sa["run_end"], "b": sb["run_end"]},
+                             default=str))
+        else:
+            compare(sa, sb, args.compare[0], args.compare[1])
+        return 0
+    if not args.logs:
+        p.error("give at least one obs log (or --compare A B)")
+    events = load_run(args.logs)
+    if not events:
+        print(f"no parseable events in {args.logs}", file=sys.stderr)
+        return 1
+    s = summarize(events)
+    if args.json:
+        print(json.dumps(s, default=str))
+    else:
+        render(s)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,6 +171,25 @@ def main(argv=None):
         results = {}
         for tier in ("A", "B"):
             results[tier] = bench_tier(args, port, addr, tier, n_nodes, log)
+        # cross-check against the SERVER-side registry percentiles (the
+        # obs-backed `stats` figures): server p50 measures the handler only,
+        # so it must not exceed the client p50 (which adds the socket round
+        # trip) by more than scheduling noise — a bigger gap means the two
+        # clocks disagree about where the time goes. p50 ONLY: the server's
+        # histogram also holds the warmup pass (its one-time bucket compiles
+        # dominate a tail quantile but cannot move the median), so its p99
+        # is printed for context, not compared.
+        stats = serve.request(port, {"op": "stats"}, addr=addr or "127.0.0.1")
+        for tier in ("A", "B"):
+            sp50 = stats.get(f"tier_{tier.lower()}_p50_ms", 0.0)
+            sp99 = stats.get(f"tier_{tier.lower()}_p99_ms", 0.0)
+            cp50 = results[tier][0]
+            log(f"tier {tier} server-side: p50 {sp50:.3f} ms (client-side "
+                f"p50 {cp50:.3f} ms; delta = socket + queueing) | p99 "
+                f"{sp99:.3f} ms incl. warmup compiles — not comparable")
+            if sp50 > cp50 * 1.5 + 0.5:
+                log(f"  WARNING: tier {tier} server p50 exceeds client p50 "
+                    f"— registry/clock skew, treat percentiles as suspect")
         for tier in ("A", "B"):
             p50, p99, qps = results[tier]
             emit_serve_metric("serve_p50_ms", p50, tier=tier)

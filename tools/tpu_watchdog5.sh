@@ -86,17 +86,30 @@ requeue_line() {  # requeue_line <line> <why>
 
 # Exit-code-driven requeue policy — replaces the old alive()/wait_alive()
 # liveness polling entirely.
-handle_rc() {  # handle_rc <rc> <line> ; returns 0 when the line was handled
+
+# Exits 76/77 now leave post-mortem FILES (obs.py: stacks + metrics +
+# divergence report under {ckpt_path}/postmortem); record the path in the
+# status file so triage starts from the dump, not the scrollback.
+log_postmortem() {  # log_postmortem <run_log>
+  local pm
+  pm=$(grep -o 'post-mortem dump: [^ ]*' "$1" 2>/dev/null | tail -1)
+  [ -n "$pm" ] && echo "  $pm" >> "$STATUS"
+}
+
+handle_rc() {  # handle_rc <rc> <line> <run_log>; 0 when the line was handled
   case "$1" in
     75) requeue_line "$2" "exit 75 preempted: relaunch resumes"; return 0;;
     76) echo "TRIAGE exit 76 (divergence) on: $2" >> "$STATUS"
+        log_postmortem "$3"
         requeue_line "$2" "exit 76 diverged"; return 0;;
     77) echo "exit 77 (hung/coordinator timeout); backing off 120s" \
           >> "$STATUS"
+        log_postmortem "$3"
         sleep 120
         requeue_line "$2" "exit 77 hung"; return 0;;
     78) echo "TRIAGE exit 78 (coordinated abort — checkpoint state needs a "\
-"human) on: $2; NOT requeued" >> "$STATUS"; return 0;;
+"human) on: $2; NOT requeued" >> "$STATUS"
+        log_postmortem "$3"; return 0;;
   esac
   return 1
 }
@@ -179,7 +192,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   rc=$?
   FRESH=$(fresh_ok "$LOGDIR/w5_${STAMP}_q$i.log" && echo 1 || echo 0)
   echo "run[$i] rc=$rc fresh=$FRESH" >> "$STATUS"
-  if handle_rc "$rc" "$LINE"; then
+  if handle_rc "$rc" "$LINE" "$LOGDIR/w5_${STAMP}_q$i.log"; then
     :   # resilience exit code: the requeue policy above already acted
   elif [ "$FRESH" -eq 1 ]; then
     RAN_ANY=1
