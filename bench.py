@@ -239,12 +239,21 @@ def _vfeat(v):
     return v[8] if len(v) > 8 else 1
 
 
+def _vhr(v):
+    """Halo-refresh period K of a variant tuple (10th field: the staleness-
+    bounded cached-halo reuse of parallel/halo.py — epoch 0 pays the full
+    exchange, steady-state epochs redraw only chunk epoch%K, ~1/K the wire
+    bytes); shorter tuples mean 1 — pre-existing names and queue lines stay
+    valid."""
+    return v[9] if len(v) > 9 else 1
+
+
 def _vname(v):
     """Candidate display/CLI name for a (spmm, use_pallas, gather_dtype,
-    dense_dtype, tile[, halo[, overlap[, replicas[, feat]]]]) variant tuple
-    — the vocabulary --candidates and .watch_queue lines are written in
-    (unit-pinned so a rename can never silently invalidate a queued
-    tunnel-window run)."""
+    dense_dtype, tile[, halo[, overlap[, replicas[, feat[, refresh]]]]])
+    variant tuple — the vocabulary --candidates and .watch_queue lines are
+    written in (unit-pinned so a rename can never silently invalidate a
+    queued tunnel-window run)."""
     return (v[0] + ("+pallas" if v[1] else "")
             + ({"fp8": "+f8g", "int8": "+i8g"}.get(v[2], ""))
             + ("+i8d" if v[3] == "int8" else "")
@@ -252,7 +261,8 @@ def _vname(v):
             + ({"ragged": "+rag", "shift": "+shift"}.get(_vhalo(v), ""))
             + ("+ovl" if _vovl(v) == "split" else "")
             + (f"+rep{_vrep(v)}" if _vrep(v) != 1 else "")
-            + (f"+feat{_vfeat(v)}" if _vfeat(v) != 1 else ""))
+            + (f"+feat{_vfeat(v)}" if _vfeat(v) != 1 else "")
+            + (f"+hr{_vhr(v)}" if _vhr(v) != 1 else ""))
 
 
 def _emit_result_line(args, value, status=None, measured_at=None, spmm=None,
@@ -533,7 +543,12 @@ def main():
                          "shards hidden dims T-ways on the innermost feat "
                          "axis — H/T halo payloads, one psum per layer, "
                          "needs T devices: hybrid+feat2, ell+feat2, "
-                         "hybrid+pallas+feat2, hybrid+pallas+rag+ovl+feat2)"
+                         "hybrid+pallas+feat2, hybrid+pallas+rag+ovl+feat2; "
+                         "a +hrK suffix reuses cached halos for up to K "
+                         "epochs (--halo-refresh K staleness-bounded "
+                         "refresh, ~1/K steady-state wire bytes): "
+                         "hybrid+pallas+hr2, hybrid+pallas+hr4, "
+                         "hybrid+pallas+rag+ovl+hr4)"
                          " — for short TPU-tunnel windows. The pallas names "
                          "only exist on a TPU backend without --no-pallas; "
                          "an all-unknown list is an error (exit 2), never a "
@@ -691,7 +706,19 @@ def main():
                      ("hybrid", True, "native", "native", 512, "padded",
                       "off", 1, 2),
                      ("hybrid", True, "native", "native", 512, "ragged",
-                      "split", 1, 2)]
+                      "split", 1, 2),
+                     # staleness-bounded halo refresh (--halo-refresh K):
+                     # steady-state epochs redraw only chunk epoch%K of each
+                     # boundary set and reuse the cached rows elsewhere. On
+                     # the single bench chip this measures the cached step's
+                     # compute cost (plan + where-combine overhead); the
+                     # ~K x wire-byte win itself needs a multi-part pod
+                     ("hybrid", True, "native", "native", 512, "padded",
+                      "off", 1, 1, 2),
+                     ("hybrid", True, "native", "native", 512, "padded",
+                      "off", 1, 1, 4),
+                     ("hybrid", True, "native", "native", 512, "ragged",
+                      "split", 1, 1, 4)]
     universe += [("hybrid", False, "native", "native", 512),
                  ("hybrid", False, "native", "native", 256),
                  ("hybrid", False, "native", "int8", 512),
@@ -783,6 +810,7 @@ def main():
                       overlap=_vovl(variant),
                       replicas=_vrep(variant),
                       feat=_vfeat(variant),
+                      halo_refresh=_vhr(variant),
                       heads=2 if args.model == "gat" else 1,
                       n_layers=args.layers,
                       n_hidden=args.hidden, use_pp=True, dropout=0.5,
@@ -842,13 +870,24 @@ def main():
         _, _, opt = init_training(cfg, spec, mesh)
         log("compiling + warmup...")
         t0 = time.time()
-        params, state, opt, loss = fns.train_step(
-            params, state, opt, jnp.uint32(0), blk, tables_d, skey, dkey)
+        cache, tables_r_d = None, None
+        if fns.train_step_full is not None:
+            # +hrK: epoch 0 is the full-refresh step (historical exchange
+            # geometry, same loss as fns.train_step — the step-0 gate below
+            # stays meaningful) and seeds the halo cache the measured
+            # steady-state epochs reuse
+            tables_r_d = place_replicated(fns.tables_refresh, mesh)
+            params, state, opt, loss, cache = fns.train_step_full(
+                params, state, opt, jnp.uint32(0), blk, tables_d, skey, dkey)
+        else:
+            params, state, opt, loss = fns.train_step(
+                params, state, opt, jnp.uint32(0), blk, tables_d, skey, dkey)
         log(f"  first step (compile) {time.time() - t0:.1f}s, "
             f"loss={float(loss):.4f}")
         from bnsgcn_tpu.utils.timers import estimate_static_hbm
         hbm = estimate_static_hbm([blk], [params, opt, state])
-        return fns, blk, tables_d, params, state, opt, loss, hbm
+        return (fns, blk, tables_d, params, state, opt, loss, cache,
+                tables_r_d, hbm)
 
     def measure(built, name="run"):
         """Timed epochs; chains CHUNK epochs between host syncs so the
@@ -856,10 +895,23 @@ def main():
         free-running epoch loop). Under --profile-dir the FIRST chunk is
         traced (device-lane op breakdown); its timing includes profiler
         overhead, which is why traced runs never update best_known."""
-        fns, blk, tables_d, params, state, opt, loss, _ = built
+        (fns, blk, tables_d, params, state, opt, loss, cache,
+         tables_r, _) = built
+        use_refresh = cache is not None
         CHUNK = 4
         total_t, min_t = 0.0, float("inf")
         e = 1
+        if use_refresh:
+            # the steady-state (cached) step compiles on ITS first call —
+            # run it once untimed so +hrK candidates get the same
+            # compile-excluded treatment as everyone else (whose only
+            # compile happened in setup_and_compile)
+            params, state, opt, loss, cache = fns.train_step_cached(
+                params, state, opt, jnp.uint32(e), blk, tables_r, cache,
+                skey, dkey)
+            _ = float(loss)
+            e += 1
+        n_timed = max(args.epochs - e + 1, 1)
         tracing = False
         if args.profile_dir:
             jax.profiler.start_trace(os.path.join(
@@ -870,9 +922,15 @@ def main():
                 n = min(CHUNK, args.epochs - e + 1)
                 t0 = time.perf_counter()
                 for _ in range(n):
-                    params, state, opt, loss = fns.train_step(
-                        params, state, opt, jnp.uint32(e), blk, tables_d,
-                        skey, dkey)
+                    if use_refresh:
+                        params, state, opt, loss, cache = \
+                            fns.train_step_cached(
+                                params, state, opt, jnp.uint32(e), blk,
+                                tables_r, cache, skey, dkey)
+                    else:
+                        params, state, opt, loss = fns.train_step(
+                            params, state, opt, jnp.uint32(e), blk, tables_d,
+                            skey, dkey)
                     e += 1
                 _ = float(loss)   # force device sync through the host read
                 dt = time.perf_counter() - t0
@@ -886,7 +944,9 @@ def main():
         finally:
             if tracing:           # exception mid-measure: never leak the
                 jax.profiler.stop_trace()   # trace into the next candidate
-        return total_t / args.epochs, min_t, loss
+        if min_t == float("inf"):     # --epochs 1 +hrK: warmup ate the run
+            min_t = total_t / n_timed
+        return total_t / n_timed, min_t, loss
 
     best, ref_loss, ref_final = None, None, None
     # step-0 / final losses of the NATIVE (unquantized) run of each SpMM
@@ -1009,6 +1069,10 @@ def main():
             # 'base' strips their suffixes, so without this exclusion a
             # feat2 run's loss would silently gate its quantized siblings
             multi_dev = _vrep(variant) > 1 or _vfeat(variant) > 1
+            # +hrK reuses up-to-(K-1)-epoch-stale halos BY DESIGN: its
+            # trajectory legitimately drifts from the exact exchange, so it
+            # rides the widened gate and never becomes a native twin either
+            stale = _vhr(variant) > 1
             base = variant[0] + ("+pallas" if variant[1] else "")
             # quantized variants gate against their NATIVE TWIN (same SpMM
             # base, native gathers/tiles) at 5%: the twin isolates exactly
@@ -1021,7 +1085,7 @@ def main():
             # (+featT only reorders float sums, but shares the exclusion).
             if quantized and base in native_l0:
                 gate0, tol0, gsrc = native_l0[base], 0.05, f"native {base}"
-            elif quantized or multi_dev:
+            elif quantized or multi_dev or stale:
                 gate0, tol0, gsrc = ref_loss, 0.07, "ell anchor"
             else:
                 gate0, tol0, gsrc = ref_loss, 0.02, "ell anchor"
@@ -1045,7 +1109,7 @@ def main():
         # diverges the trajectory); same twin-first gating as step 0
         if quantized and base in native_lf:
             gate_f, tol, gsrc = native_lf[base], 0.05, f"native {base}"
-        elif quantized or multi_dev:
+        elif quantized or multi_dev or stale:
             gate_f, tol, gsrc = ref_final, 0.07, "ell anchor"
         else:
             gate_f, tol, gsrc = ref_final, 0.02, "ell anchor"
@@ -1053,7 +1117,7 @@ def main():
             log(f"  spmm={name} final loss {lf:.4f} != {gsrc} "
                 f"{gate_f:.4f} (tol {tol:.0%}); DISCARDED")
             continue
-        if not quantized and not multi_dev:
+        if not quantized and not multi_dev and not stale:
             # record the twin reference only for a native run that passed
             # BOTH gates — a diverged native run must never become the
             # gate its quantized twins are judged against

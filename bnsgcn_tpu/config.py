@@ -132,6 +132,27 @@ class Config:
                                         #  hop-count tiebreak; logged at startup)
     halo_wire: str = "native"           # interconnect payload dtype for the training halo
                                         # exchange: 'native' | 'bf16' | 'fp8' (e4m3 + scales)
+    halo_refresh: int = 1               # staleness-bounded halo cache: reuse each
+                                        # layer's received halo block for up to K
+                                        # epochs, refreshing ~1/K of every boundary
+                                        # set per epoch (round-robin over position
+                                        # chunks) so steady-state wire bytes drop
+                                        # ~K x without a synchronized staleness
+                                        # cliff. Gradients stop at stale cached
+                                        # rows (exact w.r.t. the forward actually
+                                        # computed). 1 = the historical per-epoch
+                                        # exchange, bit-identical. The cache is
+                                        # never checkpointed: rollback/--resume
+                                        # invalidate it and force one full-refresh
+                                        # (peak-wire) epoch
+    halo_mode: str = "exchange"         # 'exchange' (activations cross the wire as
+                                        # configured above) | 'grad-only' (the
+                                        # Grappa extreme: skip the activation
+                                        # exchange entirely and aggregate from
+                                        # local rows only — zero halo block,
+                                        # presence-masked out of GAT softmax;
+                                        # the per-step gradient all-reduce is the
+                                        # only collective left)
     overlap: str = "off"                # 'off' (fused exchange-then-aggregate; the
                                         # historical step graph) | 'split' (interior/
                                         # frontier row-split aggregation: the halo
@@ -315,6 +336,16 @@ def create_parser() -> argparse.ArgumentParser:
     both("halo-exchange", type=str, default="padded",
          choices=["padded", "shift", "ragged", "auto"])
     both("halo-wire", type=str, default="native", choices=["native", "bf16", "fp8", "int8"])
+    both("halo-refresh", type=int, default=1,
+         help="reuse each layer's received halo block for up to K epochs, "
+              "refreshing ~1/K of every boundary set per epoch round-robin "
+              "(steady-state wire bytes drop ~K x; 1 = exchange every epoch, "
+              "bit-identical to the pre-cache path)")
+    both("halo-mode", type=str, default="exchange",
+         choices=["exchange", "grad-only"],
+         help="'grad-only' skips the activation exchange entirely "
+              "(local-only aggregation; the per-step gradient all-reduce is "
+              "the only collective left)")
     p.add_argument("--overlap", type=str, default="off", choices=["off", "split"])
     both("streaming-artifacts", type=str, default="auto",
          choices=["auto", "always", "never"])

@@ -88,6 +88,15 @@ class GraphEnv:
     out_norm: Optional[jax.Array]      # [n_src_ext] float — GCN: sqrt(out_deg) incl. halos
     exchange: Callable[[int, jax.Array], tuple[jax.Array, Optional[jax.Array]]]
     # exchange(layer, h[n_dst, d]) -> (h_ext [n_src_ext, d], presence [n_src_ext] bool|None)
+    #
+    # Contract: the halo tail of h_ext need NOT come from a live collective
+    # this step — it only has to be zero wherever presence is False, so
+    # sum-aggregation skips absent slots and the GAT softmax masks them.
+    # Besides the per-epoch halo_apply, trainer.py injects: the
+    # --halo-refresh cached step (this epoch's refreshed chunk live, every
+    # other row a stop-gradient cached block from an earlier epoch, presence
+    # merged accordingly) and --halo-mode grad-only (all-zero halo tail,
+    # presence False on every halo slot — aggregation over local rows only).
     gat_feat0: Optional[tuple[jax.Array, Optional[jax.Array]]] = None
     training: bool = True
     rng: Optional[jax.Array] = None
@@ -112,7 +121,10 @@ class GraphEnv:
     # fused exchange + sum-aggregation override (--overlap split re-threads
     # the layer body as start-exchange -> interior-agg -> finish-exchange ->
     # frontier-agg -> merge through this seam). None = the historical
-    # exchange-then-aggregate path.
+    # exchange-then-aggregate path. Under --halo-refresh the cached step
+    # threads the same split body through the ~K-x-smaller partial-refresh
+    # exchange and merges stored halo rows after halo_finish — a cache-hit
+    # epoch's "collective" is tiny, so the split is near-pure compute.
     feat_axis: Optional[str] = None    # 3-D ('replicas','parts','feat') mesh
     n_feat_shards: int = 1             # (parallel/feat.py): shardable layers
                                        # run exchange+SpMM on an H/T column

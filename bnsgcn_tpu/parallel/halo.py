@@ -33,7 +33,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bnsgcn_tpu.parallel.sampling import identity_sample, pair_key, pair_sample
+from bnsgcn_tpu.parallel.sampling import (chunk_identity_sample, chunk_sample,
+                                          identity_sample, pair_key,
+                                          pair_sample)
 
 
 @dataclass(frozen=True)
@@ -286,6 +288,154 @@ def make_halo_plan(spec: HaloSpec, tables: dict, bnd: jax.Array,
     presence = jnp.concatenate(
         [jnp.ones(spec.pad_inner, dtype=bool), presence[:-1]])
     return HaloPlan(sel=sel, weight=weight, slots=slots, presence=presence)
+
+
+# ----------------------------------------------------------------------------
+# staleness-bounded refresh (--halo-refresh K): epoch e re-exchanges only the
+# boundary positions {k : k % K == e % K} of every pair ("chunk" e % K), so
+# the per-epoch wire bytes drop ~K x while every halo row is at most K-1
+# epochs stale, with staleness staggered across rows instead of cliffing all
+# at once. The partial exchange reuses halo_start/halo_finish UNCHANGED: only
+# the spec geometry (sized to the largest chunk) and the plan (chunk-domain
+# draws mapped back to full boundary positions) differ, so all three
+# strategies x four wire codecs compose for free.
+# ----------------------------------------------------------------------------
+
+def make_refresh_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
+                      rate: float, refresh: int, axis_name: str = "parts",
+                      strategy: str = "padded", wire: str = "native",
+                      replica_axis: str | None = None
+                      ) -> tuple[HaloSpec, dict]:
+    """Geometry + tables for the --halo-refresh K partial exchange.
+
+    The spec keeps the FULL pad_boundary (halo slot layout — and therefore
+    n_halo and the cache buffer shape — identical to the full exchange's),
+    but pad_send / shift_pads / pair_send are sized to the largest chunk, so
+    `wire_bytes(spec)` reports the true steady-state cost. Tables are
+    [K, P, P] chunk-major device arrays; the plan builder dynamically
+    indexes them with the traced chunk e % K.
+
+    Per-chunk inv_ratio = n_bc / s_c keeps each refreshed chunk an unbiased
+    estimate of ITS slice of the boundary sum; mixed with cached rows drawn
+    under earlier epochs' keys, the steady-state halo buffer remains an
+    unbiased (stale) estimate of the full boundary aggregation — and exact
+    at rate 1.0, where K > 1 differs from the per-epoch exchange only
+    through staleness. At K=1 the tables and geometry reduce bit-identically
+    to `make_halo_spec`'s."""
+    K = int(refresh)
+    assert K >= 1, f"halo refresh period must be >= 1, got {K}"
+    n_b = np.asarray(n_b, dtype=np.int64)
+    P = n_b.shape[0]
+    exact = rate >= 1.0
+    c_idx = np.arange(K, dtype=np.int64).reshape(K, 1, 1)
+    # |{k in [0, n_b) : k % K == c}| — per-chunk boundary counts [K, P, P]
+    n_bc = (np.maximum(n_b[None] - c_idx, 0) + K - 1) // K
+    if exact:
+        s_c = n_bc
+    else:
+        # floor(rate * chunk) like the full path, but never 0 for a pair the
+        # full exchange serves: a permanently silent chunk would bias the
+        # steady-state aggregation instead of merely adding variance
+        full_send = (rate * n_b).astype(np.int64)
+        s_c = np.where((n_bc > 0) & (full_send[None] > 0),
+                       np.maximum((rate * n_bc).astype(np.int64), 1), 0)
+    ratio_c = np.where(n_bc > 0, s_c / np.maximum(n_bc, 1), 0.0)
+    inv_ratio_c = np.where(ratio_c > 0, 1.0 / np.maximum(ratio_c, 1e-30), 0.0)
+    pair_send = s_c.max(axis=0)                    # [P, P] worst chunk per pair
+    pad_b_chunk = (pad_boundary + K - 1) // K      # chunk-domain boundary pad
+    # NO x8 lane rounding here, unlike make_halo_spec: chunk sends are small
+    # and rounding up would erase exactly the ~K x byte saving the refresh
+    # mode exists for (round8(ceil(s/K)) == round8(s) for modest s)
+    pad_send = max(1, int(pair_send.max())) if pair_send.size else 1
+    pad_send = min(pad_send, max(pad_b_chunk, 1))
+    shift_pads = []
+    for k in range(1, P):
+        m = int(max(pair_send[p, (p + k) % P] for p in range(P)))
+        shift_pads.append(0 if m == 0 else min(m, pad_send))
+    assert strategy in ("padded", "shift", "ragged"), (
+        f"unresolved halo strategy {strategy!r} (resolve 'auto' via "
+        f"select_halo_strategy before make_refresh_spec)")
+    spec = HaloSpec(
+        n_parts=P, pad_inner=pad_inner, pad_boundary=pad_boundary,
+        pad_send=pad_send, axis_name=axis_name, exact=exact,
+        strategy=strategy, wire=wire, shift_pads=tuple(shift_pads),
+        pair_send=tuple(map(tuple, pair_send.tolist())),
+        replica_axis=replica_axis,
+    )
+    tables = {"n_b": jnp.asarray(n_bc, jnp.int32),
+              "send_size": jnp.asarray(s_c, jnp.int32),
+              "inv_ratio": jnp.asarray(inv_ratio_c, jnp.float32)}
+    return spec, tables
+
+
+def make_halo_plan_refresh(spec: HaloSpec, tables: dict, bnd: jax.Array,
+                           epoch: jax.Array, base_key: jax.Array,
+                           refresh: int) -> HaloPlan:
+    """This epoch's PARTIAL send/scatter plan under --halo-refresh K.
+
+    Chunk c = epoch % K of every boundary list is redrawn through the SAME
+    `pair_key` stream as the full plan — deterministic per (epoch, pair,
+    replica, nonce) with zero index communication, exactly like BNS.
+    `spec`/`tables` come from `make_refresh_spec`; slots and presence live
+    in the FULL pad_boundary slot layout, so `halo_finish`'s buffer drops
+    straight into the cache and this plan's presence covers ONLY the
+    refreshed chunk's halo rows (the caller merges it with the cached
+    presence). Runs inside shard_map, like `make_halo_plan`."""
+    K = int(refresh)
+    P, Bp, Sp = spec.n_parts, spec.pad_boundary, spec.pad_send
+    Bp_c = (Bp + K - 1) // K
+    c = jax.lax.rem(epoch.astype(jnp.uint32), jnp.uint32(K)).astype(jnp.int32)
+    me = jax.lax.axis_index(spec.axis_name)
+    peers = jnp.arange(P)
+
+    n_b_c = tables["n_b"][c]                   # [P, P] this chunk's counts
+    s_c = tables["send_size"][c]
+    n_send, s_send = n_b_c[me], s_c[me]
+    n_recv, s_recv = n_b_c[:, me], s_c[:, me]
+
+    if spec.exact:
+        pos, valid = jax.vmap(
+            lambda n: chunk_identity_sample(n, c, K, Sp))(n_send)
+        rpos, rvalid = jax.vmap(
+            lambda n: chunk_identity_sample(n, c, K, Sp))(n_recv)
+    else:
+        rep = (jax.lax.axis_index(spec.replica_axis)
+               if spec.replica_axis is not None else None)
+        send_keys = jax.vmap(
+            lambda j: pair_key(base_key, epoch, me, j, replica=rep))(peers)
+        recv_keys = jax.vmap(
+            lambda q: pair_key(base_key, epoch, q, me, replica=rep))(peers)
+        pos, valid = jax.vmap(
+            lambda k, n, s: chunk_sample(k, n, s, c, K, Bp_c, Sp))(
+                send_keys, n_send, s_send)
+        rpos, rvalid = jax.vmap(
+            lambda k, n, s: chunk_sample(k, n, s, c, K, Bp_c, Sp))(
+                recv_keys, n_recv, s_recv)
+
+    # invalid rows carry chunk-domain padding positions that can map past
+    # Bp; clamp them into range — their weight is 0 and their slot is trash,
+    # so the clamped gather/scatter targets are never observed
+    pos = jnp.minimum(pos, Bp - 1)
+    rpos = jnp.minimum(rpos, Bp - 1)
+    sel = jnp.take_along_axis(bnd, pos.astype(bnd.dtype), axis=1)          # [P, S]
+    weight = jnp.where(valid, tables["inv_ratio"][c][me][:, None], 0.0)    # [P, S]
+    slots = jnp.where(rvalid, peers[:, None] * Bp + rpos, spec.n_halo)     # [P, S]
+
+    presence = jnp.zeros(spec.n_halo + 1, dtype=bool).at[slots.reshape(-1)].set(True)
+    presence = jnp.concatenate(
+        [jnp.ones(spec.pad_inner, dtype=bool), presence[:-1]])
+    return HaloPlan(sel=sel, weight=weight, slots=slots, presence=presence)
+
+
+def refresh_row_mask(spec: HaloSpec, refresh: int, epoch: jax.Array) -> jax.Array:
+    """[n_halo] bool: halo slots whose boundary position belongs to this
+    epoch's refresh chunk. Slot q*pad_boundary + k refreshes iff
+    k % K == epoch % K; the cached step keeps every other slot's stored
+    (stop-gradient) rows."""
+    K = jnp.uint32(refresh)
+    c = jax.lax.rem(epoch.astype(jnp.uint32), K)
+    k = jnp.arange(spec.n_halo, dtype=jnp.uint32) % jnp.uint32(spec.pad_boundary)
+    return (k % K) == c
 
 
 # ----------------------------------------------------------------------------

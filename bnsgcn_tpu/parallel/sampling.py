@@ -87,3 +87,31 @@ def identity_sample(n_valid: jax.Array, pad_s: int) -> tuple[jax.Array, jax.Arra
     exact runs deterministic and skips the top_k."""
     pos = jnp.arange(pad_s, dtype=jnp.int32)
     return pos, pos < n_valid
+
+
+def chunk_sample(key: jax.Array, n_valid: jax.Array, s_valid: jax.Array,
+                 chunk, stride: int, pad_b: int,
+                 pad_s: int) -> tuple[jax.Array, jax.Array]:
+    """`pair_sample` restricted to one residue class of the boundary list.
+
+    The staleness-bounded refresh (--halo-refresh K, parallel/halo.py)
+    redraws only the positions {k : k % K == chunk} of each boundary set per
+    epoch. Those positions form their own contiguous domain t = 0..n_valid-1
+    (full position = chunk + stride*t with stride = K); sampling in that
+    domain through the SAME `pair_key` stream keeps the refreshed subset
+    deterministic per (epoch, pair, replica, nonce) — exactly the property
+    BNS relies on for zero-communication agreement — and preserves
+    pair_sample's contiguous-valid-prefix contract that the ragged wire
+    packing depends on. Returns FULL boundary positions plus the valid mask;
+    `chunk` may be a traced scalar (it is epoch % K inside the step)."""
+    pos, valid = pair_sample(key, n_valid, s_valid, pad_b, pad_s)
+    return chunk + stride * pos, valid
+
+
+def chunk_identity_sample(n_valid: jax.Array, chunk, stride: int,
+                          pad_s: int) -> tuple[jax.Array, jax.Array]:
+    """Full-rate analog of `chunk_sample`: positions chunk + stride*t for
+    t < n_valid, in order. The rate-1.0 refresh path (every boundary node in
+    this epoch's chunk crosses the wire; no top_k)."""
+    pos, valid = identity_sample(n_valid, pad_s)
+    return chunk + stride * pos, valid

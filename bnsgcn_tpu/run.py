@@ -313,6 +313,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         blk["feat"] = blk["feat"].astype(jnp.bfloat16)
     tables = place_replicated(tables, mesh)
     tables_full_d = place_replicated(tables_full, mesh)
+    tables_refresh_d = (place_replicated(fns.tables_refresh, mesh)
+                        if fns.tables_refresh is not None else None)
     if spec.use_pp:
         out = fns.precompute(blk, tables_full_d)
         if cfg.dtype == "bfloat16":
@@ -338,6 +340,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         halo_label += f"+rep{fns.n_replicas}"
     if fns.n_feat > 1:
         halo_label += f"+feat{fns.n_feat}"
+    use_refresh = fns.train_step_full is not None   # --halo-refresh K > 1
+    grad_only = fns.halo_mode == "grad-only"
+    if grad_only:
+        halo_label += "+go"
+    elif use_refresh:
+        halo_label += f"+hr{fns.halo_refresh}"
     # wire bytes are PER REPLICA per device (each replica row runs its own
     # parts-axis exchange) and reported exactly once — the replica axis adds
     # one fused gradient all-reduce per step, never more halo traffic. The
@@ -371,12 +379,34 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     # says, plus the config the run is actually executing — the record
     # obs_report joins epochs/lifecycle events against
     halo_wire_mb = wire_bytes(hspec, hid_w, nb) / 1e6
+    # --halo-refresh K: halo_wire_mb above is the PEAK (full-refresh-epoch)
+    # cost; steady-state (cache-hit) epochs ship only the ~1/K partial
+    # exchange. Both numbers go to the log and the run_header — reporting
+    # just the peak was the old header's lie for duty-cycled runs.
+    # grad-only ships nothing per step at all.
+    steady_wire_mb = halo_wire_mb
+    if grad_only:
+        steady_wire_mb = 0.0
+        log("  halo grad-only: 0.00 MB/exchange steady-state (no activation "
+            "exchange; the gradient all-reduce is the only collective)")
+    elif use_refresh:
+        from bnsgcn_tpu.parallel.halo import make_refresh_spec
+        hspec_r, _ = make_refresh_spec(
+            art.n_b, art.pad_inner, art.pad_boundary, cfg.sampling_rate,
+            fns.halo_refresh, strategy=hspec.strategy, wire=hspec.wire)
+        steady_wire_mb = wire_bytes(hspec_r, hid_w, nb) / 1e6
+        log(f"  halo refresh K={fns.halo_refresh}: peak {halo_wire_mb:.2f} "
+            f"MB/exchange (full-refresh epochs), steady-state "
+            f"{steady_wire_mb:.2f} MB "
+            f"({steady_wire_mb / max(halo_wire_mb, 1e-12):.0%} of peak)")
     if obs is not None:
         obs.emit(
             "run_header", mesh=mesh_desc(mesh),
             replicas=int(fns.n_replicas), parts=int(cfg.n_partitions),
             feat=int(fns.n_feat), halo=halo_label, wire=hspec.wire,
             wire_mb_per_exchange=round(halo_wire_mb, 4),
+            wire_mb_steady=round(steady_wire_mb, 4),
+            halo_refresh=int(fns.halo_refresh), halo_mode=fns.halo_mode,
             partition={"pad_inner": int(art.pad_inner),
                        "pad_boundary": int(art.pad_boundary),
                        "pad_send": int(hspec.pad_send),
@@ -385,7 +415,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 "dataset", "graph_name", "model", "n_layers", "n_hidden",
                 "heads", "sampling_rate", "lr", "dtype", "spmm",
                 "use_pallas", "spmm_gather", "spmm_dense", "halo_exchange",
-                "halo_wire", "overlap", "n_epochs", "log_every", "seed",
+                "halo_wire", "halo_refresh", "halo_mode", "overlap",
+                "n_epochs", "log_every", "seed",
                 "inductive", "use_pp", "resilience", "coord")})
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
@@ -722,11 +753,27 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     exch_widths = [_wire_w(cfg.n_hidden)] * max(spec.n_graph_layers - 1, 0)
     if not spec.use_pp and spec.model != "gat" and spec.n_graph_layers > 0:
         exch_widths.append(_wire_w(max(cfg.n_feat, 1)))
+    if grad_only:
+        # no per-step activation exchange exists: an exchange microbench
+        # would report a collective the training step never runs
+        exch_widths = []
+
+    def _comm_bench(w):
+        """One exchange-microbench call at width w — the partial-refresh
+        geometry when the run is in steady state (K > 1), else the full
+        exchange. This is the sampled Comm(s) twin of what the step on the
+        wire actually does."""
+        if use_refresh:
+            return fns.exchange_only_refresh(blk, tables_refresh_d,
+                                             jnp.uint32(epoch), sample_key,
+                                             width=w)
+        return fns.exchange_only(blk, tables, jnp.uint32(epoch), sample_key,
+                                 width=w)
 
     # compile the comm microbenches outside the timed region
+    epoch = 0
     for w in set(exch_widths):
-        fns.exchange_only(blk, tables, jnp.uint32(0), sample_key,
-                          width=w).block_until_ready()
+        _comm_bench(w).block_until_ready()
 
     # profiler window (SURVEY §5.1 upgrade: the reference's wall-clock comm
     # spans are meaningless under XLA; named traces are the TPU equivalent),
@@ -793,6 +840,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                                 # resume point (newer ckpts all corrupt) rebases
                                 # the list instead of corrupting its indexing
     epoch = start_epoch
+    # --halo-refresh cache state: None means the next step runs the
+    # full-refresh geometry and rebuilds the cache. Starts invalid (fresh run
+    # OR resume — checkpoints never hold the cache) and is re-invalidated at
+    # every rollback, which is what keeps --resume/rollback deterministic.
+    halo_cache = None
+    cache_reason = "resume" if start_epoch > 0 else "start"
     # The loop is a `while` so the divergence guard can move `epoch`
     # BACKWARD (rollback to the last good checkpoint, resilience.py); with
     # --resilience off no hook below fires and the schedule is exactly the
@@ -845,13 +898,44 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 jax.profiler.start_trace(trace_dir)
                 tracing = True
             t0 = time.perf_counter()
-            params, state, opt_state, loss = fns.train_step(
-                params, state, opt_state, jnp.uint32(epoch), blk, tables,
-                sample_key, drop_key)
+            if use_refresh:
+                # --halo-refresh K: an invalidated cache (run start, resume,
+                # rollback) forces one full-refresh epoch at peak wire cost;
+                # every other epoch runs the ~1/K partial exchange against
+                # the cache. The cache is never checkpointed — it is
+                # host-held device state only, rebuilt by the next
+                # full-refresh epoch after any restore.
+                refresh_full = halo_cache is None
+                if refresh_full:
+                    params, state, opt_state, loss, halo_cache = (
+                        fns.train_step_full(
+                            params, state, opt_state, jnp.uint32(epoch), blk,
+                            tables, sample_key, drop_key))
+                else:
+                    params, state, opt_state, loss, halo_cache = (
+                        fns.train_step_cached(
+                            params, state, opt_state, jnp.uint32(epoch), blk,
+                            tables_refresh_d, halo_cache, sample_key,
+                            drop_key))
+            else:
+                refresh_full = False
+                params, state, opt_state, loss = fns.train_step(
+                    params, state, opt_state, jnp.uint32(epoch), blk, tables,
+                    sample_key, drop_key)
             loss.block_until_ready()
             dt = time.perf_counter() - t0
             loss_f = float(loss)
             usr1_in_step = usr1_tracing     # profiler overhead rides dt
+            if use_refresh and refresh_full:
+                # lifecycle marker: this epoch rebuilt the halo cache at peak
+                # wire cost (obs_report surfaces these against the
+                # duty-cycled steady-state epochs)
+                if obs is not None:
+                    obs.emit("halo_refresh", epoch=epoch,
+                             k=int(fns.halo_refresh), reason=cache_reason)
+                log(f"  halo cache: full refresh at epoch {epoch} "
+                    f"({cache_reason}); next {fns.halo_refresh - 1}+ epochs "
+                    f"reuse cached blocks")
 
             # ---- divergence guard: free loss check every step (the loop
             # fetched it for res.losses anyway) + param-norm probe every
@@ -936,6 +1020,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                         loss_base = restart
                     else:
                         del res.losses[restart - loss_base:]
+                    # the halo cache was built by epochs past the restore
+                    # point — rolled-back training must not see them (the
+                    # replayed epoch re-runs full-refresh, bitwise like a
+                    # fresh run from that checkpoint)
+                    halo_cache, cache_reason = None, "rollback"
                     resil.watchdog.touch()      # restore+ack was boundary
                     epoch = restart             # work, not step time
                     continue
@@ -953,6 +1042,9 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                     loss_base = restart
                 else:
                     del res.losses[restart - loss_base:]
+                # stale halo cache from the diverged timeline: invalidate so
+                # the replayed epoch rebuilds it (full-refresh, deterministic)
+                halo_cache, cache_reason = None, "rollback"
                 resil.watchdog.touch()      # restore+backoff was boundary
                 epoch = restart             # work, not step time
                 continue
@@ -1042,14 +1134,17 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
             if comm_traced is not None:
                 comm_t = comm_traced
-            elif epoch == timer.warmup or (epoch + 1) % cfg.log_every == 0:
+            elif exch_widths and (epoch == timer.warmup
+                                  or (epoch + 1) % cfg.log_every == 0):
                 # comm microbench: exchange-only programs at each real layer
-                # width, x2 for the backward (transposed) exchange
+                # width, x2 for the backward (transposed) exchange. Under
+                # --halo-refresh _comm_bench runs the partial-refresh
+                # geometry — the steady-state cost, matching what all but
+                # the 1-in-K full-refresh epochs put on the wire
                 comm_t = 0.0
                 for w in exch_widths:
                     t1 = time.perf_counter()
-                    fns.exchange_only(blk, tables, jnp.uint32(epoch),
-                                      sample_key, width=w).block_until_ready()
+                    _comm_bench(w).block_until_ready()
                     comm_t += (time.perf_counter() - t1) * 2
             # epochs inside the trace window carry profiler-collection
             # overhead in dt — exclude them from the reported means like
@@ -1070,9 +1165,17 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 # epochs must not report as p99 step time
                 if clean_step and epoch >= timer.warmup:
                     obs.registry.histogram("train/step_s").observe(dt)
+                # wire_mb is THIS epoch's actual exchange cost: duty-cycled
+                # under --halo-refresh (peak on full-refresh epochs, the
+                # ~1/K steady cost otherwise), 0 under grad-only — the
+                # per-epoch evidence for the K-vs-bytes regression
+                epoch_wire_mb = (halo_wire_mb if (not use_refresh and
+                                                  not grad_only)
+                                 else halo_wire_mb if refresh_full
+                                 else steady_wire_mb)
                 rec = {"epoch": epoch, "loss": round(loss_f, 6),
                        "step_s": round(dt, 6),
-                       "wire_mb": round(halo_wire_mb, 4)}
+                       "wire_mb": round(epoch_wire_mb, 4)}
                 if pnorm is not None:
                     rec["param_norm"] = round(pnorm, 6)
                 if comm_t:

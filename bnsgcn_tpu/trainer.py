@@ -31,14 +31,15 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from bnsgcn_tpu.config import Config
+from bnsgcn_tpu.config import Config, ConfigError
 from bnsgcn_tpu.data.artifacts import PartitionArtifacts
 from bnsgcn_tpu.models.gnn import GraphEnv, ModelSpec, apply_model, init_params
 from bnsgcn_tpu.ops.spmm import agg_sum
 from bnsgcn_tpu.parallel.halo import (HaloSpec, full_rate_spec, halo_apply,
                                       halo_finish, halo_start,
-                                      make_halo_plan, make_halo_spec,
-                                      precompute_exchange)
+                                      make_halo_plan, make_halo_plan_refresh,
+                                      make_halo_spec, make_refresh_spec,
+                                      precompute_exchange, refresh_row_mask)
 from bnsgcn_tpu.parallel.mesh import (make_parts_mesh, parts_sharding,
                                        replicated_sharding, shard_map)
 from bnsgcn_tpu.parallel import feat as feat_mod
@@ -172,18 +173,45 @@ class StepFns:
                               # shard_map'd loss with (P() when n_feat == 1) —
                               # run.py/tests place params and optimizer state
                               # with it so checkpoints stay feat-invariant
+    train_step_full: Callable = None  # --halo-refresh K>1 only: the
+                              # full-refresh step — the historical exchange
+                              # geometry, additionally RETURNING the
+                              # per-layer halo cache. Runs at epoch 0 and
+                              # after every rollback/resume (the cache is
+                              # never checkpointed)
+    train_step_cached: Callable = None  # the steady-state step: refreshes
+                              # chunk epoch%K of every boundary set through
+                              # the ~K-x-smaller partial exchange, reuses
+                              # the cached (stop-gradient) rows everywhere
+                              # else, returns the updated cache
+    exchange_only_refresh: Callable = None  # Comm(s) microbench on the
+                              # partial-refresh geometry — the steady-state
+                              # wire cost run.py reports for K>1 epochs
+    tables_refresh: dict = None  # [K, P, P] chunk-major tables for the
+                              # cached step / microbench (host copy; run.py
+                              # places them replicated). None at K == 1
+    halo_refresh: int = 1     # resolved --halo-refresh period K
+    halo_mode: str = "exchange"  # resolved --halo-mode
 
 
 def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
                rng, edge_chunk: int, training: bool, aggregate=None,
                gat_ell=None, remat: bool = False,
                agg_exchange=None, n_replicas: int = 1,
-               feat_axis=None, n_feat: int = 1) -> GraphEnv:
+               feat_axis=None, n_feat: int = 1,
+               exchange=None, presence=None) -> GraphEnv:
+    # `exchange`/`presence` override the per-epoch fused exchange and its
+    # presence mask — the --halo-refresh cached step (fresh chunk + stored
+    # rows) and --halo-mode grad-only (zero halo block) ride this seam;
+    # None = the historical halo_apply, bit-identical
+    if presence is None:
+        presence = plan.presence
     return GraphEnv(
         src=blk.get("src"), dst=blk.get("dst"), n_dst=hspec.pad_inner,
         in_norm=blk["in_norm"], out_norm=blk["out_norm"],
-        exchange=lambda i, h: (halo_apply(hspec, plan, h), plan.presence),
-        gat_feat0=((blk["feat0_ext"], plan.presence)
+        exchange=(exchange if exchange is not None
+                  else (lambda i, h: (halo_apply(hspec, plan, h), presence))),
+        gat_feat0=((blk["feat0_ext"], presence)
                    if spec.model == "gat" and "feat0_ext" in blk else None),
         training=training, rng=rng, edge_chunk=edge_chunk,
         axis_name=hspec.axis_name, inner_mask=blk["inner_mask"],
@@ -314,6 +342,30 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                                    strategy=halo_strategy, wire=cfg.halo_wire,
                                    replica_axis=rep_axis)
     hspec_full, tables_full = full_rate_spec(art.n_b, art.pad_inner, art.pad_boundary)
+    # staleness-bounded halo communication (--halo-refresh K / --halo-mode):
+    # K > 1 builds a second, ~K-x-smaller exchange geometry for the
+    # steady-state cached step; grad-only skips activation exchange entirely.
+    # Validated here (not in config post-init) so directly-constructed
+    # Configs in tests hit the same guard as the CLI.
+    refresh_k = getattr(cfg, "halo_refresh", 1)
+    refresh_k = 1 if refresh_k is None else int(refresh_k)
+    if refresh_k < 1:
+        raise ConfigError(f"--halo-refresh must be >= 1, got {refresh_k}")
+    halo_mode = getattr(cfg, "halo_mode", "exchange")
+    if halo_mode not in ("exchange", "grad-only"):
+        raise ConfigError(
+            f"--halo-mode must be 'exchange' or 'grad-only', got {halo_mode!r}")
+    grad_only = halo_mode == "grad-only"
+    if grad_only and refresh_k > 1:
+        if jax.process_index() == 0:
+            print("halo-mode=grad-only never exchanges activations; "
+                  "--halo-refresh has no effect", file=sys.stderr)
+        refresh_k = 1
+    hspec_r, tables_refresh = None, None
+    if refresh_k > 1:
+        hspec_r, tables_refresh = make_refresh_spec(
+            art.n_b, art.pad_inner, art.pad_boundary, rate, refresh_k,
+            strategy=halo_strategy, wire=cfg.halo_wire, replica_axis=rep_axis)
     n_train = max(art.n_train, 1)
     multilabel = art.multilabel
     axis = hspec.axis_name
@@ -406,7 +458,10 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     overlap = cfg.overlap
     if overlap == "split":
         reason = None
-        if spec.model not in ("gcn", "graphsage"):
+        if grad_only:
+            reason = ("halo-mode=grad-only skips the activation exchange "
+                      "entirely — there is no collective to overlap")
+        elif spec.model not in ("gcn", "graphsage"):
             reason = (f"model={spec.model!r} aggregates through the masked "
                       f"edge softmax, which consumes the whole halo block")
         elif jax.process_count() > 1:
@@ -600,16 +655,24 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             return None
         return (gat_spec, {k: blk[k] for k in gat_keys})
 
-    def _split_agg_for(blk, plan):
+    def _split_agg_for(blk, plan, spec_h=None, combine=None):
         """--overlap split layer body: start-exchange -> interior-agg ->
         finish-exchange -> frontier-agg -> merge. The interior aggregation
         has NO data dependency on the collective, so the XLA latency-hiding
         scheduler can run the exchange while it computes. Returned callable
-        becomes GraphEnv.agg_exchange; None keeps the fused layer body."""
+        becomes GraphEnv.agg_exchange; None keeps the fused layer body.
+
+        `spec_h`/`combine` serve the --halo-refresh cached step: the plan's
+        exchange runs on the partial-refresh geometry (same pad_inner /
+        n_halo, ~K-x-smaller sends — a near-pure-compute epoch) and
+        `combine(i, buf)` merges the fresh chunk into the stored rows before
+        the frontier aggregation. Defaults are the historical fused-geometry
+        path, bit-identical."""
         if overlap != "split":
             return None
+        spec_h = hspec if spec_h is None else spec_h
         out_norm = blk["out_norm"]
-        ni = hspec.pad_inner
+        ni = spec_h.pad_inner
 
         def scale(x, norm):
             # the GCN symmetric norm, applied piecewise: elementwise
@@ -619,13 +682,15 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         if split_kind == "segment":
             def agg(i, h, scale_out_norm):
                 with jax.named_scope("halo_start"):
-                    recv = halo_start(hspec, plan, h)
+                    recv = halo_start(spec_h, plan, h)
                 h_in = scale(h, out_norm[:ni]) if scale_out_norm else h
                 with jax.named_scope("interior_agg"):
                     o_i = agg_sum(h_in, blk["seg_int_src"],
                                   blk["seg_int_dst"], ni, cfg.edge_chunk)
                 with jax.named_scope("halo_finish"):
-                    buf = halo_finish(hspec, plan, recv, h)
+                    buf = halo_finish(spec_h, plan, recv, h)
+                if combine is not None:
+                    buf = combine(i, buf)
                 h_halo = scale(buf, out_norm[ni:]) if scale_out_norm else buf
                 with jax.named_scope("frontier_agg"):
                     o_f = agg_sum(jnp.concatenate([h_in, h_halo], 0),
@@ -641,12 +706,14 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
         def agg(i, h, scale_out_norm):
             with jax.named_scope("halo_start"):
-                recv = halo_start(hspec, plan, h)
+                recv = halo_start(spec_h, plan, h)
             h_in = scale(h, out_norm[:ni]) if scale_out_norm else h
             with jax.named_scope("interior_agg"):
                 o_i = int_spmm(a_i, h_in)
             with jax.named_scope("halo_finish"):
-                buf = halo_finish(hspec, plan, recv, h)
+                buf = halo_finish(spec_h, plan, recv, h)
+            if combine is not None:
+                buf = combine(i, buf)
             h_halo = scale(buf, out_norm[ni:]) if scale_out_norm else buf
             with jax.named_scope("frontier_agg"):
                 o_f = fro_spmm(a_f, jnp.concatenate([h_in, h_halo], 0))
@@ -662,16 +729,37 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             return key
         return jax.random.fold_in(key, jax.lax.axis_index(rep_axis))
 
+    def _grad_only_override():
+        """--halo-mode grad-only (the Grappa extreme): NO activation
+        collective at all — the halo block is zero (aggregation sees local
+        rows plus zero-initialized halo state) and presence masks every halo
+        slot, so GAT's masked edge softmax excludes them identically. The
+        loss psum's AD transpose still all-reduces the gradients — the one
+        per-step collective the mode keeps. Returns (None, None) outside
+        grad-only so default paths stay structurally untouched."""
+        if not grad_only:
+            return None, None
+        presence = jnp.concatenate(
+            [jnp.ones(hspec.pad_inner, dtype=bool),
+             jnp.zeros(hspec.n_halo, dtype=bool)])
+
+        def exchange(i, h):
+            pad = jnp.zeros((hspec.n_halo, h.shape[-1]), h.dtype)
+            return jnp.concatenate([h, pad], 0), presence
+        return exchange, presence
+
     def local_loss(params, state, blk, tables, epoch, sample_key, drop_key):
         blk = {k: v[0] for k, v in blk.items()}
         plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
         me = jax.lax.axis_index(axis)
         rng = jax.random.fold_in(
             jax.random.fold_in(_replica_fold(drop_key), epoch), me)
+        exch, pres = _grad_only_override()
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
                          aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
                          remat=cfg.remat, agg_exchange=_split_agg_for(blk, plan),
-                         n_replicas=n_rep, feat_axis=fe_axis, n_feat=n_fe)
+                         n_replicas=n_rep, feat_axis=fe_axis, n_feat=n_fe,
+                         exchange=exch, presence=pres)
         logits, new_state = apply_model(params, state, spec, blk["feat"], env)
         if multilabel:
             ls = bce_sum(logits, blk["label"], blk["train_mask"])
@@ -710,6 +798,162 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             params, state, blk, tables, epoch, sample_key, drop_key)
         return loss, grads
 
+    # ---- --halo-refresh K > 1: the staleness-bounded step pair. Built only
+    # then — at K == 1 nothing below traces and the historical step above is
+    # the one and only training path (structural bit-identity). ----
+    refresh_fns = {}
+    if refresh_k > 1:
+        if cfg.remat and jax.process_index() == 0:
+            print("halo-refresh>1: the refresh steps return per-layer halo "
+                  "buffers as step outputs, which cannot escape a "
+                  "jax.checkpoint region — --remat is ignored for them "
+                  "(numerics unchanged; memory savings lost)",
+                  file=sys.stderr)
+
+        def _make_refresh_loss(cached: bool):
+            """local_loss variant that additionally maintains the halo cache
+            {'presence': [n_halo] bool, 'layer_i': [n_halo, d_i]}.
+
+            cached=False — the FULL-refresh step: the historical exchange
+            (bit-identical math to local_loss) that records every layer's
+            received halo buffer + presence into the cache it returns. Runs
+            at epoch 0 and whenever rollback/resume invalidated the cache.
+
+            cached=True — the steady-state step: chunk epoch%K of each
+            boundary set is redrawn through the ~K-x-smaller partial
+            exchange (same pair_key streams — deterministic per epoch/
+            replica/nonce); every other halo row comes from the cache under
+            stop_gradient. Gradients stay exact w.r.t. the forward actually
+            computed: stale rows are constants, fresh rows back-prop through
+            the wire codec's custom VJPs as always — so the backward
+            collective also runs on the refresh geometry."""
+            spec_h = hspec_r if cached else hspec
+
+            def body(params, state, blk, tables_, cache, epoch, sample_key,
+                     drop_key):
+                blk = {k: v[0] for k, v in blk.items()}
+                ni = hspec.pad_inner
+                if cached:
+                    cache_l = {k: v[0] for k, v in cache.items()}
+                    plan = make_halo_plan_refresh(
+                        spec_h, tables_, blk["bnd"], epoch, sample_key,
+                        refresh_k)
+                    mask = refresh_row_mask(spec_h, refresh_k, epoch)
+                    # a refreshed chunk's presence replaces its stored bits;
+                    # stale chunks keep the presence of the epoch that last
+                    # drew them (their rows ARE that epoch's sample)
+                    presence_h = jnp.where(mask, plan.presence[ni:],
+                                           cache_l["presence"])
+                else:
+                    plan = make_halo_plan(hspec, tables_, blk["bnd"], epoch,
+                                          sample_key)
+                    mask = None
+                    presence_h = plan.presence[ni:]
+                presence = jnp.concatenate(
+                    [jnp.ones(ni, dtype=bool), presence_h])
+                cache_out = {
+                    "presence": jax.lax.stop_gradient(presence_h)[None]}
+
+                def combine(i, fresh):
+                    if cached:
+                        old = jax.lax.stop_gradient(
+                            cache_l[f"layer_{i}"]).astype(fresh.dtype)
+                        fresh = jnp.where(mask[:, None], fresh, old)
+                    cache_out[f"layer_{i}"] = jax.lax.stop_gradient(
+                        fresh)[None]
+                    return fresh
+
+                def exchange(i, h):
+                    recv = halo_start(spec_h, plan, h)
+                    buf = combine(i, halo_finish(spec_h, plan, recv, h))
+                    return jnp.concatenate([h, buf], 0), presence
+
+                me = jax.lax.axis_index(axis)
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(_replica_fold(drop_key), epoch), me)
+                env = _local_env(
+                    spec, spec_h, blk, plan, rng, cfg.edge_chunk, True,
+                    aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
+                    agg_exchange=_split_agg_for(blk, plan, spec_h=spec_h,
+                                                combine=combine),
+                    n_replicas=n_rep, feat_axis=fe_axis, n_feat=n_fe,
+                    exchange=exchange, presence=presence)
+                logits, new_state = apply_model(params, state, spec,
+                                                blk["feat"], env)
+                if multilabel:
+                    ls = bce_sum(logits, blk["label"], blk["train_mask"])
+                else:
+                    ls = ce_sum(logits, blk["label"], blk["train_mask"])
+                loss = jax.lax.psum(ls / loss_denom, loss_axes)
+                return loss, (new_state, cache_out)
+
+            if cached:
+                return body
+            # the full-refresh step takes no cache input
+            return (lambda params, state, blk, tables_, epoch, sample_key,
+                    drop_key: body(params, state, blk, tables_, None, epoch,
+                                   sample_key, drop_key))
+
+        # the cache travels as a stacked (per-(replica,part)-varying) pytree:
+        # each mesh slot keeps its own blocks — replicas drew independent
+        # samples, feat shards hold H/T-wide slices
+        sharded_full = shard_map(
+            _make_refresh_loss(False), mesh=mesh,
+            in_specs=(param_spec, rep, blk_spec, rep, rep, rep, rep),
+            out_specs=(rep, (rep, stacked)))
+        sharded_cached = shard_map(
+            _make_refresh_loss(True), mesh=mesh,
+            in_specs=(param_spec, rep, blk_spec, rep, stacked, rep, rep, rep),
+            out_specs=(rep, (rep, stacked)))
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step_full(params, state, opt_state, epoch, blk, tables,
+                            sample_key, drop_key):
+            (loss, (new_state, cache)), grads = jax.value_and_grad(
+                sharded_full, has_aux=True)(
+                    params, state, blk, tables, epoch, sample_key, drop_key)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_state, opt_state, loss, cache
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 6))
+        def train_step_cached(params, state, opt_state, epoch, blk, tables_r,
+                              cache, sample_key, drop_key):
+            (loss, (new_state, new_cache)), grads = jax.value_and_grad(
+                sharded_cached, has_aux=True)(
+                    params, state, blk, tables_r, cache, epoch, sample_key,
+                    drop_key)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_state, opt_state, loss, new_cache
+
+        def local_exchange_only_refresh(blk, tables_r, epoch, sample_key,
+                                        width):
+            blk = {k: v[0] for k, v in blk.items()}
+            plan = make_halo_plan_refresh(hspec_r, tables_r, blk["bnd"],
+                                          epoch, sample_key, refresh_k)
+            comm_dtype = (jnp.bfloat16 if cfg.dtype == "bfloat16"
+                          else jnp.float32)
+            h = jnp.zeros((hspec_r.pad_inner, width), dtype=comm_dtype)
+            out = halo_finish(hspec_r, plan, halo_start(hspec_r, plan, h), h)
+            return jnp.sum(out)[None]
+
+        def exchange_only_refresh(blk, tables_r, epoch, sample_key, width):
+            """Comm(s) microbench on the partial-refresh geometry — what a
+            steady-state (cache-hit) epoch actually puts on the wire."""
+            f = shard_map(partial(local_exchange_only_refresh, width=width),
+                          mesh=mesh,
+                          in_specs=(blk_spec, rep, rep, rep),
+                          out_specs=stacked)
+            return f(blk, tables_r, epoch, sample_key)
+
+        refresh_fns = dict(
+            train_step_full=train_step_full,
+            train_step_cached=train_step_cached,
+            exchange_only_refresh=jax.jit(exchange_only_refresh,
+                                          static_argnames="width"),
+            tables_refresh=tables_refresh)
+
     def local_forward(params, state, blk, tables, epoch, sample_key, drop_key):
         blk = {k: v[0] for k, v in blk.items()}
         plan = make_halo_plan(hspec, tables, blk["bnd"], epoch, sample_key)
@@ -718,10 +962,12 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         if drop_key is not None:
             rng = jax.random.fold_in(
                 jax.random.fold_in(_replica_fold(drop_key), epoch), me)
+        exch, pres = _grad_only_override()
         env = _local_env(spec, hspec, blk, plan, rng, cfg.edge_chunk, True,
                          aggregate=_aggregate_for(blk), gat_ell=_gat_ell_for(blk),
                          agg_exchange=_split_agg_for(blk, plan),
-                         n_replicas=n_rep, feat_axis=fe_axis, n_feat=n_fe)
+                         n_replicas=n_rep, feat_axis=fe_axis, n_feat=n_fe,
+                         exchange=exch, presence=pres)
         logits, _ = apply_model(params, state, spec, blk["feat"], env)
         return logits[None]
 
@@ -842,7 +1088,10 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                   loss_and_grad=loss_and_grad,
                   n_replicas=n_rep,
                   n_feat=n_fe,
-                  param_spec=param_spec)
+                  param_spec=param_spec,
+                  halo_refresh=refresh_k,
+                  halo_mode=halo_mode,
+                  **refresh_fns)
     return fns, hspec, tables, tables_full
 
 

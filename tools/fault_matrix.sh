@@ -73,6 +73,21 @@ grep -q 'rolled back to' "$WORK/nan.log" \
   || { echo "FAIL  nan: no rollback line"; FAIL=1; }
 check_event nan "$WORK/obs_nan.jsonl" rollback
 
+echo "== nan@E5 under --halo-refresh 4: rollback invalidates the halo cache =="
+# the rollback restores a checkpoint saved WITHOUT the cache, so recovery
+# must replay a full-refresh epoch (reason=rollback in the obs log) — a
+# stale cache surviving the rollback would silently corrupt the replay
+python -m bnsgcn_tpu.main $BASE --halo-refresh 4 --ckpt-path "$WORK/ck_k4" \
+  --obs-log "$WORK/obs_k4.jsonl" --inject nan@E5 > "$WORK/nan_k4.log" 2>&1
+check nan_k4 0 $?
+grep -q 'rolled back to' "$WORK/nan_k4.log" \
+  || { echo "FAIL  nan_k4: no rollback line"; FAIL=1; }
+grep -q 'full refresh at epoch 4 (rollback)' "$WORK/nan_k4.log" \
+  || { echo "FAIL  nan_k4: no cache-invalidation full-refresh line"; FAIL=1; }
+check_event nan_k4 "$WORK/obs_k4.jsonl" rollback
+check_event nan_k4 "$WORK/obs_k4.jsonl" halo_refresh
+K4_LOSS=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/nan_k4.log" | cut -d= -f2)
+
 echo "== sigterm@E3: resumable exit 75, then --resume matches ref =="
 python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_sig" \
   --obs-log "$WORK/obs_sig.jsonl" --inject sigterm@E3 \
@@ -169,6 +184,25 @@ if [ -z "$L0" ] || [ "$L0" != "$L1" ]; then
   echo "FAIL  mh_nan: rank losses diverged ('$L0' vs '$L1')"; FAIL=1
 else
   echo "PASS  mh_nan ranks agree on the healed loss ($L0)"
+fi
+
+echo "== multi-host: nan@E5:r0 under --halo-refresh 4 matches single-host =="
+# coordinated rollback with an ACTIVE halo cache on both ranks: both must
+# invalidate, replay the full-refresh epoch, and land bitwise on the
+# single-host K=4 healed loss (the recovery path is rank-consistent AND
+# cache-state-free)
+run_pair mh_k4 "$WORK/ck_mhk4" "$WORK/ck_mhk4" --halo-refresh 4 \
+  --inject nan@E5:r0 --obs-log "$WORK/obs_mh_k4.jsonl"
+check mh_k4_r0 0 $RC0
+check mh_k4_r1 0 $RC1
+check_event mh_k4 "$WORK/obs_mh_k4.jsonl" halo_refresh
+check_event mh_k4 "$WORK/obs_mh_k4.jsonl" rollback
+L0=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/mh_k4_r0.log" | cut -d= -f2)
+L1=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/mh_k4_r1.log" | cut -d= -f2)
+if [ -z "$L0" ] || [ "$L0" != "$L1" ] || [ "$L0" != "$K4_LOSS" ]; then
+  echo "FAIL  mh_k4: losses r0='$L0' r1='$L1' single-host='$K4_LOSS'"; FAIL=1
+else
+  echo "PASS  mh_k4 ranks match the single-host K=4 healed loss ($L0)"
 fi
 
 [ $FAIL -eq 0 ] && echo "fault matrix: ALL PASS ($WORK)" \

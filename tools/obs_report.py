@@ -47,7 +47,7 @@ from bnsgcn_tpu.obs import load_events  # noqa: E402  (stdlib-only import)
 
 LIFECYCLE_KINDS = ("inject", "rollback", "preempt", "watchdog_fire",
                    "divergence_abort", "coord_decision", "profile_request",
-                   "profile")
+                   "profile", "halo_refresh")
 
 
 def load_run(paths: list[str]) -> list[dict]:
@@ -140,6 +140,13 @@ def render(s: dict, write=print):
               f"{hdr.get('parts')}x{hdr.get('feat')} replicas x parts x "
               f"feat) | halo {hdr.get('halo')}/{hdr.get('wire')}: "
               f"{hdr.get('wire_mb_per_exchange')} MB/exchange/device")
+        # staleness-bounded refresh (--halo-refresh K > 1 / grad-only) runs
+        # carry a steady-state figure next to the peak one
+        if hdr.get("halo_mode", "exchange") != "exchange" \
+                or int(hdr.get("halo_refresh", 1) or 1) > 1:
+            write(f"halo refresh: K={hdr.get('halo_refresh')} "
+                  f"mode={hdr.get('halo_mode')} | steady-state "
+                  f"{hdr.get('wire_mb_steady')} MB/exchange/device")
         part = hdr.get("partition") or {}
         if part:
             write("partition: " + " ".join(f"{k}={v}"
@@ -150,9 +157,17 @@ def render(s: dict, write=print):
         multi = len(ranks) > 1
         write("")
         write("per-epoch" + (f" (ranks {ranks})" if multi else "") + ":")
+        # wire column only when epoch records carry the per-epoch figure
+        # (duty-cycled under --halo-refresh: full-refresh epochs pay peak,
+        # steady epochs the chunk-sized fraction) AND the header gives a
+        # peak to compute the saving against
+        peak_mb = _num((hdr or {}).get("wire_mb_per_exchange"))
+        has_wire = any("wire_mb" in ev for by_r in epochs.values()
+                       for ev in by_r.values())
         cols = ("  epoch   loss        step_ms   comm_ms[t=traced,"
                 "s=sampled]  param_norm  eval")
-        write(cols + ("  rank" if multi else ""))
+        write(cols + ("      wire_mb(saved)" if has_wire else "")
+              + ("  rank" if multi else ""))
         rows = []
         for e in sorted(epochs):
             for r in sorted(epochs[e]):
@@ -161,6 +176,16 @@ def render(s: dict, write=print):
                 acc = next((v for k, v in ez.items() if k.endswith("_acc")),
                            None)
                 comm = ev.get("comm_s")
+                wire = ""
+                if has_wire:
+                    w = _num(ev.get("wire_mb"))
+                    if math.isfinite(w):
+                        saved = (f" (-{(1 - w / peak_mb):.0%})"
+                                 if math.isfinite(peak_mb) and peak_mb > 0
+                                 and w < peak_mb else "")
+                        wire = f"   {w:8.4f}{saved:<8}"
+                    else:
+                        wire = f"   {'-':>8}{'':<8}"
                 rows.append(
                     f"  {e:5d}   {_num(ev.get('loss')):<9.4f}  "
                     f"{_num(ev.get('step_s', 0.0)) * 1e3:8.2f}  "
@@ -169,6 +194,7 @@ def render(s: dict, write=print):
                        if comm is not None else f"{'-':>9}{'':<17}")
                     + f"  {ev.get('param_norm', ''):<10}  "
                     + (f"{_num(acc):.4f}" if acc is not None else "-")
+                    + wire
                     + (f"     r{r}" if multi else ""))
         rows, elided = _elide(rows)
         for row in rows:
@@ -284,7 +310,19 @@ def compare(sa: dict, sb: dict, name_a: str, name_b: str, write=print):
         cfg = hdr.get("config", {})
         write(f"  {tag}: {cfg.get('model', '?')} spmm={cfg.get('spmm', '?')} "
               f"halo={hdr.get('halo', '?')}/{hdr.get('wire', '?')} mesh="
-              f"{hdr.get('mesh', '?')} wire_mb={hdr.get('wire_mb_per_exchange')}")
+              f"{hdr.get('mesh', '?')} wire_mb={hdr.get('wire_mb_per_exchange')}"
+              f" halo_refresh={hdr.get('halo_refresh', 1)}"
+              f" steady_mb={hdr.get('wire_mb_steady')}")
+    ka = ((sa["header"] or {}).get("halo_refresh", 1),
+          (sa["header"] or {}).get("halo_mode", "exchange"))
+    kb = ((sb["header"] or {}).get("halo_refresh", 1),
+          (sb["header"] or {}).get("halo_mode", "exchange"))
+    if ka != kb:
+        # the comm split differs BY DESIGN between these runs — step/loss
+        # deltas below mix a staleness effect with everything else
+        write(f"  NOTE: halo refresh differs (A K={ka[0]} mode={ka[1]} vs "
+              f"B K={kb[0]} mode={kb[1]}) — comm volume and staleness are "
+              f"part of the trajectory delta")
     if sa["bench"] or sb["bench"]:
         by = {}
         for tag, s in (("a", sa), ("b", sb)):
