@@ -1,0 +1,53 @@
+"""graftlint — the repo-native SPMD-aware static-analysis suite.
+
+~12.6k LoC of distributed JAX is hazard-dense in ways generic linters
+cannot see: a collective whose axis name is not bound by the enclosing
+mesh deadlocks a pod, a reused PRNG key silently correlates "independent"
+samples, a `float()` on a traced value inside a jitted scope forces a host
+sync (or a trace-time error that only fires on the TPU path), a read of a
+buffer after it was donated to `train_step` returns garbage, and a
+lock-guarded field read outside its lock is a data race the CPU tests win
+by luck. Every one of those invariants used to live only in reviewer
+memory; at pod scale each escape costs a hardware window (ROADMAP:
+real-pod campaign preflight).
+
+graftlint walks the repo's own ASTs with six rule families grounded in
+this codebase (see `analysis/core.py` RULE_DOCS or
+``python -m bnsgcn_tpu.analysis --list-rules``):
+
+  spmd-*      collective axis-name discipline (cross-checked against the
+              mesh axis vocabulary built from `parallel/halo.py`'s
+              HaloSpec fields and `make_mesh` literals) + collectives
+              under rank-dependent control flow
+  prng-*      key discipline: no literal keys outside tests, no key
+              reuse, replica-fold-FIRST ordering (sampling.pair_key)
+  host-sync-* `.item()` / `float(traced)` / `np.asarray` / `device_get`
+              / traced-value branches inside jitted scopes
+  donate-*    use-after-donate through `donate_argnums` (the
+              `train_step_cached` halo-cache path)
+  lock-*      `# guarded-by: <lock>` annotated shared state accessed
+              outside `with <lock>:`
+  obs-* /     emitted event kinds must be registered in obs.EVENT_KINDS;
+  exit-*      exits 75/76/77/78 must use the resilience named constants
+
+Inline suppressions REQUIRE a reason::
+
+    x = jax.random.key(0)   # graftlint: disable=prng-literal-key(eval
+                            # path is deterministic by design)
+
+A reasonless ``disable=`` is itself a finding. Findings carry file:line,
+rule id, message and a fix hint; ``--json`` writes the machine-readable
+report `tools/lint.sh` gates CI on.
+
+Static analysis is paired with the `--strict-exec` RUNTIME guard
+(`bnsgcn_tpu/strict.py`, wired through run.py): a transfer guard plus a
+compile-event listener prove the steady-state training step performs zero
+implicit host transfers and zero recompiles after each step variant's
+first execution.
+"""
+
+from bnsgcn_tpu.analysis.core import (DEFAULT_TARGETS, Finding, RULE_DOCS,
+                                      lint_paths, report_json)
+
+__all__ = ["Finding", "lint_paths", "report_json", "RULE_DOCS",
+           "DEFAULT_TARGETS"]

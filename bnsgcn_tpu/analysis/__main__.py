@@ -1,0 +1,86 @@
+"""CLI for graftlint: ``python -m bnsgcn_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 active findings, 2 files failed to parse.
+`tools/lint.sh` is the thin CI wrapper around this entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from bnsgcn_tpu.analysis.core import (DEFAULT_TARGETS, RULE_DOCS,
+                                      iter_py_files, lint_paths, report_json,
+                                      resolve_paths, resolve_root,
+                                      write_report)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bnsgcn_tpu.analysis",
+        description="graftlint — SPMD-aware static analysis for this repo")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: "
+                         f"{' '.join(DEFAULT_TARGETS)} under the repo root)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths (default: inferred)")
+    ap.add_argument("--json", dest="json_path", default=None, metavar="PATH",
+                    help="write the machine-readable report here "
+                         "('-' for stdout)")
+    ap.add_argument("--select", default=None, metavar="RULES",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress per-finding lines (summary only)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in RULE_DOCS)
+        for rule, (desc, hint) in sorted(RULE_DOCS.items()):
+            print(f"{rule:<{width}}  {desc}")
+            print(f"{'':<{width}}  fix: {hint}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = select - set(RULE_DOCS)
+        if unknown:
+            print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    root = resolve_root(args.root)
+    paths = resolve_paths(args.paths or None, root)
+    active, suppressed, errors = lint_paths(
+        paths=paths, root=root, select=select)
+
+    if not args.quiet:
+        for f in active:
+            print(f.fmt())
+            if f.hint:
+                print(f"    fix: {f.hint}")
+        for path in errors:
+            print(f"{path}: parse error (file skipped)")
+
+    n_files = len(iter_py_files(paths, root))
+    report = report_json(active, suppressed, errors,
+                         root=root, n_files=n_files)
+    if args.json_path == "-":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    elif args.json_path:
+        write_report(report, args.json_path)
+
+    tag = "clean" if not active and not errors else "FAIL"
+    print(f"graftlint: {tag} — {len(active)} finding(s), "
+          f"{len(suppressed)} suppressed, {len(errors)} parse error(s)",
+          file=sys.stderr)
+    if errors:
+        return 2
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

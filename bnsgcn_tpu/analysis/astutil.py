@@ -1,0 +1,160 @@
+"""Shared AST helpers for the graftlint rule modules."""
+
+from __future__ import annotations
+
+import ast
+
+
+def qualname(node: ast.AST) -> str:
+    """Dotted source name of a Name/Attribute chain ('' when dynamic).
+
+    `jax.lax.psum` -> 'jax.lax.psum'; `spec.axis_name` ->
+    'spec.axis_name'; anything holding a call/subscript resolves to ''.
+    """
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return qualname(call.func)
+
+
+def tail(qname: str, n: int = 2) -> str:
+    """Last n dotted components: 'jax.lax.psum' -> 'lax.psum'."""
+    return ".".join(qname.split(".")[-n:])
+
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def int_const(node: ast.AST) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and isinstance(node.operand.value, int)):
+        return -node.operand.value
+    return None
+
+
+def iter_strings(node: ast.AST):
+    """Every string literal anywhere under `node` (tuples, lists, etc.)."""
+    for sub in ast.walk(node):
+        s = str_const(sub)
+        if s is not None:
+            yield s
+
+
+def parent_map(tree: ast.AST) -> dict:
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing(node: ast.AST, parents: dict, kinds) -> ast.AST | None:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def jit_scope_functions(tree: ast.AST) -> set:
+    """Function defs that trace under jit/shard_map — the scopes where
+    host syncs are hazards.
+
+    A function is a jit scope when it (a) is decorated with jax.jit /
+    partial(jax.jit, ...) / jax.checkpoint / jax.custom_vjp, (b) is
+    referenced by name as an argument to a jit/shard_map/checkpoint/
+    custom_vjp/value_and_grad/grad call anywhere in the module, or (c) is
+    lexically nested inside such a function. Returns the set of def
+    nodes (identity), nested defs included.
+    """
+    jit_wrappers = {"jax.jit", "jit", "shard_map", "jax.checkpoint",
+                    "checkpoint", "jax.custom_vjp", "custom_vjp",
+                    "jax.value_and_grad", "value_and_grad", "jax.grad",
+                    "grad", "jax.vmap", "vmap", "pl.pallas_call",
+                    "pallas_call"}
+
+    def is_jit_call(call: ast.Call) -> bool:
+        name = call_name(call)
+        if tail(name) in {"functools.partial", "partial"} or name == "partial":
+            return bool(call.args) and _expr_is_jit_ref(call.args[0])
+        return name in jit_wrappers or tail(name) in jit_wrappers \
+            or name.split(".")[-1] in {"jit", "shard_map", "pallas_call"}
+
+    def _expr_is_jit_ref(node: ast.AST) -> bool:
+        n = qualname(node)
+        return n in jit_wrappers or tail(n) in jit_wrappers \
+            or n.split(".")[-1] in {"jit", "shard_map"}
+
+    # names passed into jit wrappers: jax.jit(f), shard_map(local_loss,...)
+    wrapped_names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not is_jit_call(node):
+            continue
+        args = list(node.args)
+        if tail(call_name(node)) in {"functools.partial", "partial"} \
+                or call_name(node) == "partial":
+            args = args[1:]
+        for a in args[:1]:      # the traced callable is the first operand
+            an = qualname(a)
+            if an and "." not in an:
+                wrapped_names.add(an)
+            if isinstance(a, ast.Call):
+                # shard_map(partial(local_forward), ...)
+                for inner in a.args:
+                    innm = qualname(inner)
+                    if innm and "." not in innm:
+                        wrapped_names.add(innm)
+
+    scopes: set = set()
+
+    def mark(fn):
+        if fn in scopes:
+            return
+        scopes.add(fn)
+        for sub in ast.walk(fn):
+            if isinstance(sub, _FUNC) and sub is not fn:
+                scopes.add(sub)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, _FUNC):
+            continue
+        if node.name in wrapped_names:
+            mark(node)
+            continue
+        for dec in node.decorator_list:
+            dn = qualname(dec)
+            if isinstance(dec, ast.Call):
+                if is_jit_call(dec):
+                    mark(node)
+                    break
+                dn = call_name(dec)
+            if dn in jit_wrappers or tail(dn) in jit_wrappers:
+                mark(node)
+                break
+    return scopes
+
+
+def assigned_names(target: ast.AST):
+    """Names bound by an assignment target (tuple unpacks included)."""
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            yield node.id

@@ -1,0 +1,365 @@
+"""graftlint framework: file walking, suppressions, findings, JSON report.
+
+The rule families live in sibling ``rules_*`` modules; each exposes
+``check(module, ctx) -> list[Finding]`` plus an optional
+``collect(module, ctx)`` pre-pass that contributes cross-module context
+(the mesh axis vocabulary, the donated-callable registry, the obs event
+registry) before any rule runs. Rules see only parsed ASTs + comment
+tokens — no imports of the scanned code, so a file with a missing
+optional dependency still lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+# rule id -> (one-line description, fix hint). The single source the CLI
+# table, README table and tests enumerate. Family prefix groups ids.
+RULE_DOCS = {
+    # -- family 1: SPMD collective discipline --
+    "spmd-unbound-axis": (
+        "collective names a mesh axis outside the repo's axis vocabulary "
+        "(HaloSpec axis fields + make_mesh literals)",
+        "use an axis bound by the enclosing shard_map mesh — the "
+        "vocabulary is built from parallel/halo.py HaloSpec defaults and "
+        "make_mesh axis-name literals"),
+    "spmd-rank-branch": (
+        "collective under rank-dependent Python control flow "
+        "(axis_index/process_index in the branch condition)",
+        "hoist the collective out of the branch: a collective only some "
+        "ranks enter deadlocks the mesh"),
+    # -- family 2: PRNG key discipline --
+    "prng-literal-key": (
+        "literal PRNGKey/key constant outside tests",
+        "derive the key from the run seed via fold_in/split (see "
+        "sampling.pair_key); literal keys correlate streams across "
+        "call sites"),
+    "prng-key-reuse": (
+        "same PRNG key consumed by multiple random draws without an "
+        "intervening split/fold_in",
+        "split the key (k1, k2 = jax.random.split(key)) or fold a "
+        "distinct id per draw — reused keys make 'independent' draws "
+        "identical"),
+    "prng-replica-fold-order": (
+        "replica id folded after other stream ids (replica-fold-FIRST "
+        "is the sampling.pair_key contract)",
+        "fold the replica index before epoch/pair ids so replica r of a "
+        "2-D run equals a 1-D run with the folded base key"),
+    # -- family 3: host-sync / recompile hazards in jitted scopes --
+    "host-sync-item": (
+        ".item() inside a jitted scope forces a device sync",
+        "keep the value on device; fetch at the epoch boundary with an "
+        "explicit jax.device_get outside the jitted scope"),
+    "host-sync-cast": (
+        "float()/int()/bool() of a non-static value inside a jitted "
+        "scope concretizes a tracer",
+        "use jnp casts on device, or move the host cast outside the "
+        "jitted scope"),
+    "host-sync-numpy": (
+        "np.asarray/np.array on a traced value inside a jitted scope",
+        "use jnp.* on device; host numpy on a tracer is a sync (or a "
+        "trace error on the TPU path)"),
+    "host-sync-device-get": (
+        "jax.device_get/block_until_ready inside a jitted scope",
+        "device fetches belong outside jit; inside a traced function "
+        "they sync or fail at trace time"),
+    "host-sync-traced-branch": (
+        "Python if/while on a traced value inside a jitted scope",
+        "use jnp.where / lax.cond — a Python branch on a tracer "
+        "concretizes it (recompile per value, or trace error)"),
+    # -- family 4: donation safety --
+    "donate-use-after": (
+        "buffer read after being passed through a donated argument",
+        "donated buffers are invalidated by the call (donate_argnums); "
+        "rebind the variable from the call's result or copy before "
+        "donating"),
+    # -- family 5: lock discipline --
+    "lock-unguarded-access": (
+        "field annotated '# guarded-by: <lock>' accessed outside "
+        "'with <lock>:'",
+        "wrap the access in the annotated lock (or suppress with a "
+        "reason if the access is provably pre-thread/single-threaded)"),
+    # -- family 6: contract lints --
+    "obs-unregistered-event": (
+        "emitted obs event kind missing from obs.EVENT_KINDS",
+        "add the kind to bnsgcn_tpu/obs.py EVENT_KINDS so "
+        "tools/obs_report.py renders it and downstream joins see it"),
+    "exit-code-literal": (
+        "sys.exit/os._exit with a literal lifecycle exit code "
+        "(75/76/77/78)",
+        "use the named constants (resilience.EXIT_PREEMPTED/"
+        "EXIT_DIVERGED/EXIT_WATCHDOG/EXIT_COORD_ABORT) so the exit-code "
+        "contract is greppable"),
+    # -- framework --
+    "suppression-missing-reason": (
+        "graftlint: disable= without a (reason)",
+        "every suppression must say why: "
+        "# graftlint: disable=rule-id(the reason)"),
+    "suppression-unknown-rule": (
+        "graftlint: disable= names an unknown rule id",
+        "use a rule id from --list-rules"),
+}
+
+
+@dataclass
+class Finding:
+    file: str               # path relative to the lint root
+    line: int
+    col: int
+    rule: str
+    message: str
+    suppressed: bool = False
+    reason: str = ""        # the suppression reason, when suppressed
+
+    @property
+    def hint(self) -> str:
+        return RULE_DOCS.get(self.rule, ("", ""))[1]
+
+    def fmt(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        d = {"file": self.file, "line": self.line, "col": self.col,
+             "rule": self.rule, "message": self.message, "hint": self.hint}
+        if self.suppressed:
+            d["suppressed"] = True
+            d["reason"] = self.reason
+        return d
+
+
+# Matches the inline marker (hash, 'graftlint:', 'disable=', then a
+# comma list of rule-id(reason) items). Spelled via concatenation so
+# this file's own comments never match the marker.
+_SUPPRESS_RE = re.compile(r"#\s*graft" r"lint:\s*disable=(.*)$")
+_ITEM_RE = re.compile(r"\s*([\w-]+)\s*(?:\(([^)]*)\))?\s*(?:,|$)")
+
+
+@dataclass
+class Suppression:
+    line: int               # line the comment is on
+    rule: str
+    reason: str
+    standalone: bool        # comment-only line: also covers the next line
+    used: bool = False
+
+
+@dataclass
+class Module:
+    """One parsed source file plus its comment-derived suppressions."""
+    path: str
+    relpath: str
+    tree: ast.AST
+    source: str
+    suppressions: list = field(default_factory=list)
+    is_test: bool = False
+
+    def covered(self, line: int, rule: str):
+        """The suppression covering (line, rule), if any. A suppression
+        covers its own line; a standalone comment also covers the line
+        below it (put it directly above the flagged statement)."""
+        for s in self.suppressions:
+            if s.rule != rule:
+                continue
+            if s.line == line or (s.standalone and s.line + 1 == line):
+                return s
+        return None
+
+
+@dataclass
+class Context:
+    """Cross-module facts collected in the pre-pass, read by every rule."""
+    axis_vocab: set = field(default_factory=set)      # mesh axis names
+    donated: dict = field(default_factory=dict)       # fn name -> (positions)
+    event_kinds: set = field(default_factory=set)     # obs.EVENT_KINDS
+    have_event_registry: bool = False
+
+
+def parse_module(path: str, root: str) -> Module | None:
+    """Parse one file into a Module; None on a syntax error (reported by
+    the caller as a lint run error, not a crash)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        source = raw.decode("utf-8")
+        tree = ast.parse(source, filename=path)
+    except (SyntaxError, UnicodeDecodeError):
+        return None
+    rel = os.path.relpath(path, root)
+    mod = Module(path=path, relpath=rel, tree=tree, source=source,
+                 is_test=("tests" + os.sep) in rel or
+                         os.path.basename(rel).startswith("test_"))
+    _collect_suppressions(mod, raw)
+    return mod
+
+
+def _collect_suppressions(mod: Module, raw: bytes):
+    try:
+        toks = list(tokenize.tokenize(io.BytesIO(raw).readline))
+    except tokenize.TokenError:
+        return
+    lines = mod.source.splitlines()
+    for tok in toks:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        line = tok.start[0]
+        before = lines[line - 1][:tok.start[1]] if line <= len(lines) else ""
+        standalone = not before.strip()
+        for item in _ITEM_RE.finditer(m.group(1)):
+            rule, reason = item.group(1), (item.group(2) or "").strip()
+            if not rule:
+                continue
+            mod.suppressions.append(Suppression(
+                line=line, rule=rule, reason=reason, standalone=standalone))
+
+
+def _suppression_findings(mod: Module) -> list[Finding]:
+    out = []
+    for s in mod.suppressions:
+        if s.rule not in RULE_DOCS:
+            out.append(Finding(mod.relpath, s.line, 0,
+                               "suppression-unknown-rule",
+                               f"disable= names unknown rule {s.rule!r}"))
+        elif not s.reason:
+            out.append(Finding(mod.relpath, s.line, 0,
+                               "suppression-missing-reason",
+                               f"disable={s.rule} has no (reason) — "
+                               f"suppressions must say why"))
+    return out
+
+
+# Directories never scanned (vendored/related/caches), relative names.
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules",
+              ".claude"}
+
+# The repo's default lint surface: the package, the tools, and the
+# top-level entry points. Tests are deliberately excluded (they use
+# literal keys and host syncs by design); fixtures under tests/ are
+# linted explicitly by tests/test_analysis.py.
+DEFAULT_TARGETS = ("bnsgcn_tpu", "tools", "bench.py", "__graft_entry__.py")
+
+
+def iter_py_files(paths: list[str], root: str) -> list[str]:
+    out = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return sorted(dict.fromkeys(out))
+
+
+def _rule_modules():
+    from bnsgcn_tpu.analysis import (rules_contract, rules_donation,
+                                     rules_hostsync, rules_locks,
+                                     rules_prng, rules_spmd)
+    return [rules_spmd, rules_prng, rules_hostsync, rules_donation,
+            rules_locks, rules_contract]
+
+
+def resolve_root(root: str | None = None) -> str:
+    """The repo root: explicit, or three levels up from this file."""
+    if root is not None:
+        return os.path.abspath(root)
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def resolve_paths(paths: list[str] | None, root: str) -> list[str]:
+    if paths:
+        return list(paths)
+    return [p for p in DEFAULT_TARGETS
+            if os.path.exists(os.path.join(root, p))]
+
+
+def lint_paths(paths: list[str] | None = None, root: str | None = None,
+               select: set | None = None
+               ) -> tuple[list[Finding], list[Finding], list[str]]:
+    """Lint `paths` (files/dirs, default DEFAULT_TARGETS under `root`).
+
+    Returns (active_findings, suppressed_findings, errors):
+    active findings are what gate CI; suppressed ones carry their reason
+    into the JSON report so intentional hazards stay auditable; errors
+    are unparseable files (relative paths).
+    """
+    root = resolve_root(root)
+    paths = resolve_paths(paths, root)
+    files = iter_py_files(list(paths), root)
+    modules, errors = [], []
+    for fp in files:
+        mod = parse_module(fp, root)
+        if mod is None:
+            errors.append(os.path.relpath(fp, root))
+        else:
+            modules.append(mod)
+
+    ctx = Context()
+    rule_mods = _rule_modules()
+    for rm in rule_mods:
+        collect = getattr(rm, "collect", None)
+        if collect is not None:
+            for mod in modules:
+                collect(mod, ctx)
+
+    raw: list[Finding] = []
+    for rm in rule_mods:
+        for mod in modules:
+            raw.extend(rm.check(mod, ctx))
+    for mod in modules:
+        raw.extend(_suppression_findings(mod))
+
+    if select:
+        raw = [f for f in raw
+               if f.rule in select or f.rule.startswith("suppression-")]
+
+    active, suppressed = [], []
+    by_path = {m.relpath: m for m in modules}
+    for f in sorted(raw, key=lambda f: (f.file, f.line, f.col, f.rule)):
+        mod = by_path.get(f.file)
+        sup = mod.covered(f.line, f.rule) if mod is not None else None
+        if sup is not None and sup.reason:
+            sup.used = True
+            f.suppressed, f.reason = True, sup.reason
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed, errors
+
+
+def report_json(active: list[Finding], suppressed: list[Finding],
+                errors: list[str], root: str, n_files: int) -> dict:
+    counts: dict[str, int] = {}
+    for f in active:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "graftlint": 1,
+        "root": root,
+        "files_scanned": n_files,
+        "ok": not active and not errors,
+        "findings": [f.as_dict() for f in active],
+        "suppressed": [f.as_dict() for f in suppressed],
+        "counts": counts,
+        "errors": errors,
+    }
+
+
+def write_report(report: dict, path: str):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)

@@ -1,0 +1,82 @@
+"""Rule family 6 — cross-file contract lints.
+
+obs-unregistered-event
+    Every `obs.emit("<kind>", ...)` / `emit_bounded("<kind>", ...)` kind
+    literal must appear in the central `EVENT_KINDS` registry in
+    `bnsgcn_tpu/obs.py` — the vocabulary `tools/obs_report.py` renders.
+    An unregistered kind is an event the report silently drops; the
+    telemetry bus is only as trustworthy as its schema. collect() parses
+    the registry out of the scanned obs.py AST, so the rule is inactive
+    when obs.py is outside the lint target set (fixture dirs).
+
+exit-code-literal
+    `sys.exit(75)` / `os._exit(77)` with a literal in the resilience
+    exit-code range must use the named constants (EXIT_PREEMPTED=75,
+    EXIT_DIVERGED=76, EXIT_WATCHDOG=77, EXIT_COORD_ABORT=78). The
+    orchestrator (`tools/fault_matrix.sh`, the preempt/resume wrapper)
+    dispatches on these codes; a literal drifts silently when the
+    constant moves.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bnsgcn_tpu.analysis.astutil import call_name, int_const, iter_strings
+from bnsgcn_tpu.analysis.core import Context, Finding, Module
+
+_EXIT_CODES = {75: "EXIT_PREEMPTED", 76: "EXIT_DIVERGED",
+               77: "EXIT_WATCHDOG", 78: "EXIT_COORD_ABORT"}
+
+
+def collect(mod: Module, ctx: Context):
+    if mod.relpath.replace("\\", "/").split("/")[-1] != "obs.py":
+        return
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "EVENT_KINDS" in names:
+                ctx.event_kinds.update(iter_strings(node.value))
+                ctx.have_event_registry = True
+
+
+def check(mod: Module, ctx: Context) -> list[Finding]:
+    out = []
+
+    # -- obs-unregistered-event --
+    if ctx.have_event_registry:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # `_emit` covers thin forwarders (resilience._emit -> obs.emit)
+            last = call_name(node).split(".")[-1]
+            if last not in ("emit", "emit_bounded", "_emit"):
+                continue
+            if not node.args:
+                continue
+            kind = node.args[0]
+            if isinstance(kind, ast.Constant) and isinstance(kind.value, str):
+                if kind.value not in ctx.event_kinds:
+                    out.append(Finding(
+                        mod.relpath, node.lineno, node.col_offset,
+                        "obs-unregistered-event",
+                        f"event kind {kind.value!r} is not in "
+                        f"obs.EVENT_KINDS — obs_report will not render it"))
+
+    # -- exit-code-literal --
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name not in ("sys.exit", "os._exit", "exit", "_exit"):
+            continue
+        if not node.args:
+            continue
+        code = int_const(node.args[0])
+        if code in _EXIT_CODES:
+            out.append(Finding(
+                mod.relpath, node.lineno, node.col_offset,
+                "exit-code-literal",
+                f"{name}({code}) uses a literal resilience exit code — "
+                f"use {_EXIT_CODES[code]}"))
+    return out

@@ -1,0 +1,165 @@
+"""Rule family 4 — donation safety.
+
+donate-use-after
+    A variable passed at a `donate_argnums` position of a jitted step
+    function is read again before being rebound. After donation XLA may
+    alias the buffer into the step's outputs; on TPU the read returns
+    garbage (on CPU it often still "works", which is why only the lint
+    catches it). The canonical hazard is the `train_step_cached` halo
+    cache path: the cache at donated position 6 must be rebound from the
+    step's return tuple in the SAME statement, never read stale.
+
+collect() records every donated signature visible in the scanned files:
+`@partial(jax.jit, donate_argnums=(...))` decorators and
+`g = jax.jit(f, donate_argnums=(...))` assignments. check() then flags,
+per function body and in statement order, any Name load of a variable
+previously passed at a donated position of a recorded function — by
+bare name (`train_step(...)`) or attribute tail (`fns.train_step(...)`)
+— until an assignment rebinds it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bnsgcn_tpu.analysis.astutil import call_name, int_const
+from bnsgcn_tpu.analysis.core import Context, Finding, Module
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """donate_argnums from a jax.jit(...) / partial(jax.jit, ...) call."""
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                pos = tuple(p for p in (int_const(e) for e in v.elts)
+                            if p is not None)
+                return pos
+            p = int_const(v)
+            if p is not None:
+                return (p,)
+    return None
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    return name.split(".")[-1] in ("jit", "partial") or name == "partial"
+
+
+def collect(mod: Module, ctx: Context):
+    for node in ast.walk(mod.tree):
+        # @partial(jax.jit, donate_argnums=(0, 1, 2))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                    pos = _donate_positions(dec)
+                    if pos:
+                        ctx.donated[node.name] = pos
+        # step = jax.jit(fn, donate_argnums=(0, 1, 2, 6))
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if call_name(call).split(".")[-1] == "jit":
+                pos = _donate_positions(call)
+                if pos:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            ctx.donated[t.id] = pos
+                        elif isinstance(t, ast.Attribute):
+                            ctx.donated[t.attr] = pos
+
+
+def _linear(body):
+    """Statements in source order, descending into compound bodies.
+    Nested function defs are NOT entered — they get their own pass."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if sub:
+                yield from _linear(sub)
+        for h in getattr(stmt, "handlers", []) or []:
+            yield from _linear(h.body)
+
+
+def _stmt_nodes(stmt: ast.stmt):
+    """The nodes belonging to this statement ITSELF — for compound
+    statements only the header (test/iter/items), never the nested
+    bodies, which _linear yields as their own statements. Scanning the
+    full subtree of an `if`/`while` would see loop-body reads out of
+    source order (and twice)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        yield from ast.walk(stmt.test)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        yield from ast.walk(stmt.target)
+        yield from ast.walk(stmt.iter)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            yield from ast.walk(item.context_expr)
+    elif isinstance(stmt, (ast.Try, ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        return
+    else:
+        yield from ast.walk(stmt)
+
+
+def check(mod: Module, ctx: Context) -> list[Finding]:
+    if not ctx.donated:
+        return []
+    out = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # donated[name] = (line of donating call, callee) until rebound
+        dead: dict[str, tuple[int, str]] = {}
+        for stmt in _linear(fn.body):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            nodes = list(_stmt_nodes(stmt))
+            # 1) loads of dead names anywhere in this statement
+            for node in nodes:
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and node.id in dead:
+                    line, callee = dead[node.id]
+                    out.append(Finding(
+                        mod.relpath, node.lineno, node.col_offset,
+                        "donate-use-after",
+                        f"`{node.id}` was donated to `{callee}` at line "
+                        f"{line} and read before being rebound — the "
+                        f"buffer may already be aliased into the step's "
+                        f"outputs"))
+                    del dead[node.id]       # report once per donation
+            # 2) new donating calls in this statement
+            newly_dead: dict[str, tuple[int, str]] = {}
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = call_name(node).split(".")[-1]
+                pos = ctx.donated.get(callee)
+                if not pos:
+                    continue
+                for p in pos:
+                    if p < len(node.args) and isinstance(node.args[p],
+                                                         ast.Name):
+                        newly_dead[node.args[p].id] = (node.lineno, callee)
+            # 3) rebinds in this statement revive names (same-statement
+            #    tuple reassignment `params, ... = step(params, ...)` is
+            #    the idiomatic safe pattern)
+            rebound: set[str] = set()
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            rebound.add(sub.id)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                for sub in ast.walk(stmt.target):
+                    if isinstance(sub, ast.Name):
+                        rebound.add(sub.id)
+            for name in rebound:
+                dead.pop(name, None)
+                newly_dead.pop(name, None)
+            dead.update(newly_dead)
+    return out

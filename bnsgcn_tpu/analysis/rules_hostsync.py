@@ -1,0 +1,169 @@
+"""Rule family 3 — host-sync / recompile hazards inside jitted scopes.
+
+Inside a function that traces under `jit`/`shard_map` (detection:
+`astutil.jit_scope_functions`), every one of these forces either a
+trace-time error on TPU or a silent device→host sync + recompile:
+
+host-sync-item          `x.item()` on a traced value
+host-sync-cast          `float(x)` / `int(x)` / `bool(x)` on a traced
+                        value (static shapes/len are exempt)
+host-sync-numpy         `np.asarray(x)` / `np.array(x)` on a traced value
+host-sync-device-get    `jax.device_get` / `.block_until_ready()` inside
+                        a traced scope
+host-sync-traced-branch Python `if`/`while` on a value produced by a
+                        jnp/lax/jax.random call in the same scope —
+                        trace-time ConcretizationError on TPU, or a
+                        recompile per branch value with `static_argnums`
+
+The CPU test suite masks all of these (CPU transfers are zero-copy and
+free); `--strict-exec` catches the runtime half, this family catches
+them before the run.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bnsgcn_tpu.analysis.astutil import call_name, jit_scope_functions
+from bnsgcn_tpu.analysis.core import Context, Finding, Module
+
+_TRACED_PRODUCERS = ("jnp.", "lax.", "jax.random.", "jax.lax.", "jax.nn.")
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Exprs whose cast is trace-safe: literals, len(...), x.shape[i],
+    x.ndim, x.size, arithmetic over those."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Call):
+        fn = call_name(node)
+        if fn in ("len", "min", "max", "sum", "abs", "round", "math.ceil",
+                  "math.floor", "math.prod", "math.sqrt", "math.log",
+                  "math.log2"):
+            return all(_is_static_expr(a) for a in node.args) or True
+        return False
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    if isinstance(node, ast.Name):
+        return False        # unknown name — not provably static
+    return False
+
+
+def _traced_names(fn: ast.AST) -> set[str]:
+    """Names assigned from jnp./lax./jax.random. producing calls, plus
+    names assigned from other traced names (one transitive pass)."""
+    out: set[str] = set()
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            traced = False
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call):
+                    name = call_name(sub)
+                    if any(name.startswith(p) or ("." + p) in ("." + name)
+                           for p in _TRACED_PRODUCERS) or \
+                            name.startswith("jnp") or name.startswith("lax."):
+                        traced = True
+                if isinstance(sub, ast.Name) and sub.id in out:
+                    traced = True
+            if traced:
+                for t in node.targets:
+                    for s in ast.walk(t):
+                        if isinstance(s, ast.Name):
+                            out.add(s.id)
+    return out
+
+
+def check(mod: Module, ctx: Context) -> list[Finding]:
+    out = []
+    scopes = jit_scope_functions(mod.tree)
+    for fn in scopes:
+        traced = _traced_names(fn)
+        # params of a jit scope are traced by definition
+        traced |= {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+
+        nested = {sub for sub in ast.walk(fn)
+                  if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and sub is not fn and sub in scopes}
+
+        def in_this_fn(node):
+            # nested jit-scope defs run their own pass; skip their bodies
+            for nd in nested:
+                if any(node is x for x in ast.walk(nd)):
+                    return False
+            return True
+
+        for node in ast.walk(fn):
+            if not in_this_fn(node) and not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                last = name.split(".")[-1]
+                if last == "item" and isinstance(node.func, ast.Attribute):
+                    out.append(Finding(
+                        mod.relpath, node.lineno, node.col_offset,
+                        "host-sync-item",
+                        f"`{name}()` inside jitted scope `{fn.name}` — "
+                        f"forces a device→host sync at trace time"))
+                elif name in ("float", "int", "bool") and node.args and \
+                        not _is_static_expr(node.args[0]):
+                    out.append(Finding(
+                        mod.relpath, node.lineno, node.col_offset,
+                        "host-sync-cast",
+                        f"`{name}(...)` on a possibly-traced value inside "
+                        f"jitted scope `{fn.name}`"))
+                elif name in ("np.asarray", "np.array", "numpy.asarray",
+                              "numpy.array", "onp.asarray", "onp.array") \
+                        and node.args and not _is_static_expr(node.args[0]):
+                    out.append(Finding(
+                        mod.relpath, node.lineno, node.col_offset,
+                        "host-sync-numpy",
+                        f"`{name}(...)` materialises a traced value on host "
+                        f"inside jitted scope `{fn.name}`"))
+                elif last in ("device_get", "block_until_ready") and \
+                        ("jax" in name or isinstance(node.func,
+                                                     ast.Attribute)):
+                    out.append(Finding(
+                        mod.relpath, node.lineno, node.col_offset,
+                        "host-sync-device-get",
+                        f"`{name}` inside jitted scope `{fn.name}` — "
+                        f"device round-trip in a traced region"))
+            if isinstance(node, (ast.If, ast.While)):
+                # `x is None` / `x is not None` is a static identity
+                # check — legal at trace time, never a concretization
+                none_checked: set[int] = set()
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Compare) and all(
+                            isinstance(op, (ast.Is, ast.IsNot))
+                            for op in sub.ops):
+                        for x in ast.walk(sub):
+                            none_checked.add(id(x))
+                for sub in ast.walk(node.test):
+                    if id(sub) in none_checked:
+                        continue
+                    hit = None
+                    if isinstance(sub, ast.Name) and sub.id in traced:
+                        hit = sub.id
+                    elif isinstance(sub, ast.Call):
+                        nm = call_name(sub)
+                        if nm.startswith(_TRACED_PRODUCERS) or \
+                                nm.startswith("jnp"):
+                            hit = nm
+                    if hit is not None and not _is_static_expr(node.test):
+                        out.append(Finding(
+                            mod.relpath, node.lineno, node.col_offset,
+                            "host-sync-traced-branch",
+                            f"Python branch on traced value `{hit}` inside "
+                            f"jitted scope `{fn.name}` — use lax.cond/"
+                            f"lax.select or hoist to a static arg"))
+                        break
+    return out

@@ -1,0 +1,120 @@
+"""Rule family 5 — lock discipline via `# guarded-by:` annotations.
+
+lock-unguarded-access
+    Shared mutable state in the threaded subsystems (serve.py's refresh
+    worker + tier-B batcher, resilience.py's watchdog thread, coord.py's
+    KV store) is annotated at its `__init__` assignment::
+
+        self._durs = []          # guarded-by: self._lock
+
+    Every OTHER method of the same class must then touch `self._durs`
+    only inside `with self._lock:`. An access outside the lock is a data
+    race the GIL-timed CPU tests win by luck.
+
+    Conventions the checker honours:
+      * the annotation may sit on the assignment line or the line above;
+      * methods whose name ends in `_locked` are assumed to be called
+        with the lock held (the repo's helper convention) and are not
+        flagged;
+      * `__init__` itself is exempt (single-threaded construction);
+      * nested `with` and multi-item `with a, b:` both count.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from bnsgcn_tpu.analysis.astutil import parent_map
+from bnsgcn_tpu.analysis.core import Context, Finding, Module
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+
+_EXEMPT_METHODS = {"__init__", "__repr__", "__str__"}
+
+
+def _guard_comments(mod: Module) -> dict[int, str]:
+    """line number -> normalized lock expression. A trailing comment
+    annotates its own line; a standalone comment line annotates the line
+    BELOW it (recorded under that line's number)."""
+    out = {}
+    for i, line in enumerate(mod.source.splitlines(), start=1):
+        m = _GUARD_RE.search(line)
+        if not m:
+            continue
+        standalone = not line[:line.index("#")].strip()
+        out[i + 1 if standalone else i] = m.group(1).strip()
+    return out
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _with_locks(node: ast.AST, parents: dict) -> set[str]:
+    """Normalized context exprs of every `with` enclosing `node`."""
+    locks = set()
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                try:
+                    locks.add(ast.unparse(item.context_expr).replace(" ", ""))
+                except Exception:
+                    pass
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break       # containment does not cross def boundaries
+        cur = parents.get(cur)
+    return locks
+
+
+def check(mod: Module, ctx: Context) -> list[Finding]:
+    guards = _guard_comments(mod)
+    if not guards:
+        return []
+    out = []
+    parents = parent_map(mod.tree)
+
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        # attr -> (lock expr, annotation line)
+        guarded: dict[str, tuple[str, int]] = {}
+        for stmt in ast.walk(cls):
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                lock = guards.get(stmt.lineno)
+                if lock is None:
+                    continue
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        guarded[attr] = (lock.replace(" ", ""), stmt.lineno)
+        if not guarded:
+            continue
+
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for meth in methods:
+            if meth.name in _EXEMPT_METHODS or meth.name.endswith("_locked"):
+                continue
+            for node in ast.walk(meth):
+                attr = _self_attr(node)
+                if attr is None or attr not in guarded:
+                    continue
+                lock, ann_line = guarded[attr]
+                if ast.unparse(node).replace(" ", "") == lock:
+                    continue        # the lock object itself
+                if lock in _with_locks(node, parents):
+                    continue
+                out.append(Finding(
+                    mod.relpath, node.lineno, node.col_offset,
+                    "lock-unguarded-access",
+                    f"`self.{attr}` is guarded-by `{lock}` (annotated at "
+                    f"line {ann_line}) but accessed in "
+                    f"`{cls.name}.{meth.name}` outside `with {lock}:`"))
+    return out

@@ -1,0 +1,201 @@
+"""Rule family 2 — PRNG key discipline.
+
+prng-literal-key
+    `jax.random.PRNGKey(<literal>)` / `jax.random.key(<literal>)`
+    outside tests. Literal keys correlate "independent" streams across
+    call sites; library code must derive keys from the run seed via
+    fold_in/split (the `sampling.pair_key` discipline).
+
+prng-key-reuse
+    The same key expression consumed by two or more `jax.random.*`
+    draws in one function without an intervening `split`/`fold_in`
+    rebind. Reused keys make the draws identical — the silent version
+    of the correlated-sampling bug BNS's zero-communication agreement
+    depends on never having.
+
+prng-replica-fold-order
+    In a `fold_in` chain, the replica id must be folded FIRST —
+    `pair_key(base, e, p, j, replica=r) == pair_key(fold_in(base, r),
+    e, p, j)` is the contract that makes 2-D replica meshes testable
+    against independently-seeded 1-D runs (tests/test_replicas.py). A
+    chain folding a replica-ish id after other ids breaks that
+    equivalence.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bnsgcn_tpu.analysis.astutil import call_name, int_const
+from bnsgcn_tpu.analysis.core import Context, Finding, Module
+
+# jax.random draws that CONSUME a key (first positional arg)
+_DRAWS = {"uniform", "normal", "bernoulli", "randint", "choice",
+          "permutation", "shuffle", "categorical", "gumbel", "truncated_normal",
+          "bits", "exponential", "laplace", "beta", "gamma", "poisson"}
+_DERIVERS = {"split", "fold_in"}
+
+
+def _is_random(call: ast.Call, kinds: set[str]) -> str | None:
+    name = call_name(call)
+    parts = name.split(".")
+    last = parts[-1]
+    if last not in kinds:
+        return None
+    # jax.random.uniform / random.uniform / jrandom.uniform
+    if len(parts) >= 2 and "random" in parts[-2].lower():
+        return last
+    if len(parts) == 1 and last in ("fold_in", "split"):
+        return last      # from jax.random import fold_in, split
+    return None
+
+
+def check(mod: Module, ctx: Context) -> list[Finding]:
+    out = []
+
+    # -- prng-literal-key --
+    if not mod.is_test:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            last = name.split(".")[-1]
+            if last not in ("PRNGKey", "key"):
+                continue
+            if "random" not in name:
+                continue
+            if node.args and int_const(node.args[0]) is not None:
+                out.append(Finding(
+                    mod.relpath, node.lineno, node.col_offset,
+                    "prng-literal-key",
+                    f"{name}({int_const(node.args[0])}) is a literal key "
+                    f"outside tests — streams built on it collide across "
+                    f"call sites"))
+
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        out.extend(_check_key_reuse(mod, fn))
+        out.extend(_check_fold_order(mod, fn))
+    return out
+
+
+def _check_key_reuse(mod: Module, fn: ast.AST) -> list[Finding]:
+    """Track, in statement order over one function body (nested defs get
+    their own pass), draws consuming identical key expressions."""
+    out = []
+    # consumed[key_src] = first draw line; a rebind of the underlying
+    # name (from split/fold_in or anything else) clears its entries
+    consumed: dict[str, int] = {}
+
+    def key_src(node: ast.AST) -> str | None:
+        try:
+            return ast.unparse(node)
+        except Exception:
+            return None
+
+    def root_name(node: ast.AST) -> str | None:
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def visit_stmt(stmt: ast.stmt):
+        # draws in this statement, in source order
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and _is_random(node, _DRAWS):
+                if not node.args:
+                    continue
+                src = key_src(node.args[0])
+                if src is None:
+                    continue
+                if src in consumed:
+                    out.append(Finding(
+                        mod.relpath, node.lineno, node.col_offset,
+                        "prng-key-reuse",
+                        f"key {src!r} already consumed by a draw at line "
+                        f"{consumed[src]} — split or fold_in before "
+                        f"drawing again"))
+                else:
+                    consumed[src] = node.lineno
+        # rebinds clear consumed entries rooted at the rebound name
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            rebound = set()
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        rebound.add(sub.id)
+            if rebound:
+                for src in list(consumed):
+                    rt = root_name(ast.parse(src, mode="eval").body) \
+                        if src.isidentifier() or "." in src else src
+                    base = src.split(".")[0].split("[")[0]
+                    if base in rebound:
+                        del consumed[src]
+
+    body = list(fn.body)
+    for stmt in body:
+        # branches/loops: analyze linearly (conservative — a reuse
+        # across exclusive branches may false-positive; suppress there)
+        visit_stmt(stmt)
+    return out
+
+
+def _check_fold_order(mod: Module, fn: ast.AST) -> list[Finding]:
+    """Within one function, a fold_in whose folded-id source mentions a
+    replica id must not follow an earlier fold_in on the same chain."""
+    out = []
+    # chain position per variable: var -> depth of folds that produced it
+    fold_depth: dict[str, int] = {}
+
+    def is_replica_expr(node: ast.AST) -> bool:
+        try:
+            src = ast.unparse(node)
+        except Exception:
+            return False
+        return "replica" in src or "axis_index" in src and "replica" in src
+
+    for stmt in ast.walk(fn):
+        if not isinstance(stmt, ast.Assign):
+            continue
+        call = stmt.value
+        if not isinstance(call, ast.Call) or \
+                _is_random(call, {"fold_in"}) is None:
+            continue
+        if len(call.args) < 2:
+            continue
+        base, folded = call.args[0], call.args[1]
+        base_src = ""
+        try:
+            base_src = ast.unparse(base)
+        except Exception:
+            pass
+        depth = fold_depth.get(base_src, 0)
+        # nested fold_in(fold_in(x, a), b): count inner folds + check them
+        inner = base
+        while isinstance(inner, ast.Call) and \
+                _is_random(inner, {"fold_in"}) is not None:
+            depth += 1
+            if len(inner.args) >= 2 and is_replica_expr(inner.args[1]) \
+                    and depth >= 1 and inner is not call.args[0]:
+                pass        # inner-most replica fold is position 0: fine
+            inner = inner.args[0] if inner.args else None
+            if inner is None:
+                break
+        if is_replica_expr(folded) and depth > 0:
+            out.append(Finding(
+                mod.relpath, call.lineno, call.col_offset,
+                "prng-replica-fold-order",
+                "replica id folded after other stream ids — the "
+                "replica fold must come FIRST (sampling.pair_key "
+                "contract)"))
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                try:
+                    fold_depth[t.id] = depth + 1
+                except Exception:
+                    pass
+    return out

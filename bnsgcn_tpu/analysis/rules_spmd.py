@@ -1,0 +1,169 @@
+"""Rule family 1 — SPMD collective discipline.
+
+spmd-unbound-axis
+    Every `lax.psum` / `all_to_all` / `ppermute` / `axis_index` /
+    `ragged_all_to_all` axis-name literal must belong to the repo's mesh
+    axis vocabulary. The vocabulary is built in the collect() pre-pass
+    from the scanned files themselves: axis-name string defaults on
+    HaloSpec-style dataclass fields (`axis_name: str = "parts"`,
+    `replica_axis`), axis-name literals in `make_mesh`/`jax.make_mesh`/
+    `Mesh(...)` calls, and `axis_name=`/`axis=` keyword defaults in
+    function signatures. A collective naming an axis no mesh binds
+    deadlocks the pod at the first trace on real hardware. Dynamic axis
+    expressions (`spec.axis_name`) are trusted — HaloSpec's fields are
+    exactly the audited channel for those.
+
+spmd-rank-branch
+    A collective lexically inside an `if`/`while` whose condition
+    depends on the local rank (`lax.axis_index`, `jax.process_index`)
+    is a deadlock hazard: only some ranks enter the branch, so only
+    some ranks reach the collective.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bnsgcn_tpu.analysis.astutil import (call_name, iter_strings, parent_map,
+                                         qualname, str_const, tail)
+from bnsgcn_tpu.analysis.core import Context, Finding, Module
+
+# collectives whose second positional arg (or axis_name= kw) is an axis
+COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+               "ppermute", "pshuffle", "ragged_all_to_all", "axis_index",
+               "psum_scatter"}
+
+_MESH_CTORS = {"make_mesh", "make_parts_mesh", "Mesh", "AbstractMesh"}
+_AXIS_FIELDS = {"axis_name", "replica_axis", "feat_axis", "axis"}
+
+
+def _is_collective(call: ast.Call) -> str | None:
+    name = call_name(call)
+    last = name.split(".")[-1]
+    if last in COLLECTIVES and (
+            "lax" in name or name == last or "jax" in name):
+        return last
+    return None
+
+
+def collect(mod: Module, ctx: Context):
+    """Build the mesh axis vocabulary from this module."""
+    for node in ast.walk(mod.tree):
+        # make_mesh((...), ('replicas','parts','feat')) / Mesh(devs, names)
+        if isinstance(node, ast.Call):
+            last = call_name(node).split(".")[-1]
+            if last in _MESH_CTORS:
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    for s in iter_strings(arg):
+                        ctx.axis_vocab.add(s)
+        # dataclass field defaults: axis_name: str = "parts"
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgt = node.target
+            if isinstance(tgt, ast.Name) and tgt.id in _AXIS_FIELDS:
+                s = str_const(node.value)
+                if s is not None:
+                    ctx.axis_vocab.add(s)
+        # keyword defaults: def f(..., axis_name="parts")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            pos = args.args + args.kwonlyargs
+            defaults = ([None] * (len(args.args) - len(args.defaults))
+                        + list(args.defaults) + list(args.kw_defaults))
+            for a, d in zip(pos, defaults):
+                if d is not None and a.arg in _AXIS_FIELDS:
+                    s = str_const(d)
+                    if s is not None:
+                        ctx.axis_vocab.add(s)
+
+
+def _axis_literals(call: ast.Call):
+    """Axis-name string literals passed to a collective call (positional
+    arg 2, or axis_name= keyword; tuples of names included)."""
+    cands = []
+    if len(call.args) >= 2:
+        cands.append(call.args[1])
+    elif call_name(call).split(".")[-1] == "axis_index" and call.args:
+        cands.append(call.args[0])
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            cands.append(kw.value)
+    for c in cands:
+        if isinstance(c, (ast.Tuple, ast.List)):
+            for el in c.elts:
+                s = str_const(el)
+                if s is not None:
+                    yield s, c
+        else:
+            s = str_const(c)
+            if s is not None:
+                yield s, c
+
+
+def _rank_dependent_names(fn: ast.AST) -> set[str]:
+    """Names assigned from lax.axis_index(...) / jax.process_index()."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            last = call_name(node.value).split(".")[-1]
+            if last in ("axis_index", "process_index"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _cond_is_rank_dependent(test: ast.AST, rank_names: set[str]) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in rank_names:
+            return True
+        if isinstance(node, ast.Call):
+            if call_name(node).split(".")[-1] in ("axis_index",
+                                                  "process_index"):
+                return True
+    return False
+
+
+def check(mod: Module, ctx: Context) -> list[Finding]:
+    out = []
+    parents = parent_map(mod.tree)
+
+    # -- spmd-unbound-axis --
+    if ctx.axis_vocab:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or _is_collective(node) is None:
+                continue
+            for axis, _holder in _axis_literals(node):
+                if axis not in ctx.axis_vocab:
+                    out.append(Finding(
+                        mod.relpath, node.lineno, node.col_offset,
+                        "spmd-unbound-axis",
+                        f"{call_name(node)} names axis {axis!r}, not in the "
+                        f"mesh axis vocabulary "
+                        f"{sorted(ctx.axis_vocab)}"))
+
+    # -- spmd-rank-branch --
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        rank_names = _rank_dependent_names(fn)
+        for branch in ast.walk(fn):
+            if not isinstance(branch, (ast.If, ast.While)):
+                continue
+            if not _cond_is_rank_dependent(branch.test, rank_names):
+                continue
+            for sub in ast.walk(branch):
+                if sub is branch.test or any(
+                        sub is n for n in ast.walk(branch.test)):
+                    continue
+                if isinstance(sub, ast.Call):
+                    cname = _is_collective(sub)
+                    if cname is not None and cname != "axis_index":
+                        out.append(Finding(
+                            mod.relpath, sub.lineno, sub.col_offset,
+                            "spmd-rank-branch",
+                            f"collective {call_name(sub)} under "
+                            f"rank-dependent control flow (condition at "
+                            f"line {branch.lineno}) — only some ranks "
+                            f"reach it"))
+    return out

@@ -239,6 +239,15 @@ class Config:
                                         # dumps, SIGUSR1 stack+metrics+trace
                                         # snapshots); default
                                         # {ckpt_path}/postmortem
+    strict_exec: bool = False           # strict-execution runtime guard
+                                        # (strict.py): jax.transfer_guard
+                                        # around the hot-loop step (implicit
+                                        # host transfer = error) + a compile
+                                        # listener (recompile after a step
+                                        # variant's first epoch = error).
+                                        # Proof-of-cleanliness for pod runs;
+                                        # the static half is graftlint
+                                        # (python -m bnsgcn_tpu.analysis)
 
     cache_dir: str = ""                 # persistent dir for SpMM layout pickles
                                         # (content-addressed by hybrid_layout_key);
@@ -397,6 +406,12 @@ def create_parser() -> argparse.ArgumentParser:
     both("obs-dir", type=str, default="",
          help="post-mortem dir for watchdog/divergence dumps and SIGUSR1 "
               "snapshots (default {ckpt_path}/postmortem)")
+    both("strict-exec", action="store_true", default=False,
+         help="strict-execution runtime guard: transfer_guard('disallow') "
+              "around every hot-loop step plus a compile listener — any "
+              "implicit host transfer in the step, or any recompile after "
+              "a step variant's first epoch, aborts the run "
+              "(StrictExecError). Pairs with the graftlint static gate")
     both("cache-dir", type=str,
          default=os.environ.get("BNSGCN_CACHE_DIR", ""))
     both("edge-chunk", type=int, default=0)

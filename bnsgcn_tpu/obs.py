@@ -46,8 +46,29 @@ from typing import Optional
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "EventLog", "Obs",
     "make_obs", "postmortem_dir", "write_postmortem", "load_events",
-    "rank_log_path",
+    "rank_log_path", "EVENT_KINDS",
 ]
+
+
+# The closed vocabulary of event kinds the bus carries. tools/obs_report.py
+# renders from this registry, and graftlint's obs-unregistered-event rule
+# rejects any emit() kind literal not listed here — adding an event means
+# registering it first, which is what keeps the log and every reader in
+# sync. Grouped by emitter.
+EVENT_KINDS = (
+    # training lifecycle (run.py)
+    "run_header", "epoch", "epoch_ranks", "eval", "trace", "overlap",
+    "halo_refresh", "run_end",
+    # resilience (resilience.py: injections, rollback consensus, exits)
+    "inject", "rollback", "divergence_abort", "coord_decision",
+    "watchdog_fire", "preempt", "profile_request", "profile",
+    # serving (serve.py)
+    "serve_header", "serve_drain", "delta",
+    # benchmarking (bench.py)
+    "bench_header", "bench_variant", "bench_end",
+    # strict-execution guard (strict.py, --strict-exec)
+    "strict_exec",
+)
 
 
 # ----------------------------------------------------------------------------
@@ -166,9 +187,9 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: dict[str, Counter] = {}
-        self._gauges: dict[str, Gauge] = {}
-        self._hists: dict[str, Histogram] = {}
+        self._counters: dict[str, Counter] = {}   # guarded-by: self._lock
+        self._gauges: dict[str, Gauge] = {}       # guarded-by: self._lock
+        self._hists: dict[str, Histogram] = {}    # guarded-by: self._lock
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -241,11 +262,11 @@ class EventLog:
                 max_bytes = 64 * 2 ** 20
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
-        self._f = None
-        self._size = 0
-        self._dead = False
+        self._f = None          # guarded-by: self._lock
+        self._size = 0          # guarded-by: self._lock
+        self._dead = False      # guarded-by: self._lock
         try:
-            self._open()
+            self._open_locked()
         except OSError as ex:
             # an unwritable $BNSGCN_OBS_LOG must degrade to a no-log run,
             # not crash-loop every watchdog5 relaunch before training starts
@@ -254,7 +275,7 @@ class EventLog:
                              f"{type(ex).__name__}: {ex}; telemetry log "
                              f"disabled for this run\n")
 
-    def _open(self):
+    def _open_locked(self):
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
         self._f = open(self.path, "a")
@@ -292,7 +313,7 @@ class EventLog:
             if self._size + len(line) > self.max_bytes and self._size > 0:
                 self._f.close()
                 os.replace(self.path, self.path + ".1")
-                self._open()
+                self._open_locked()
             self._f.write(line)
             self._f.flush()
             self._size += len(line)

@@ -418,8 +418,20 @@ def build_split_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
 
 
 def dense_edge_count(arrays, part: int = 0) -> int:
-    """Diagnostic: number of edges carried by the dense tiles of one part."""
-    return int(arrays["blk_tiles_fwd"][part].astype(np.int64).sum())
+    """Diagnostic: number of edges carried by the dense tiles of one part.
+
+    Layout-shape agnostic: the unified layout stores a bare
+    `blk_tiles_fwd`; the split-overlap layout prefixes its two stacks
+    (`int_blk_tiles_fwd` + `fro_blk_tiles_fwd`); and a side whose
+    occupancy filter kept zero dense tiles omits its key entirely.
+    Summing whichever keys exist covers all three (a fully-ELL layout
+    counts 0 dense edges)."""
+    total = 0
+    for key in ("blk_tiles_fwd", "int_blk_tiles_fwd", "fro_blk_tiles_fwd"):
+        tiles = arrays.get(key)
+        if tiles is not None:
+            total += int(np.asarray(tiles[part]).astype(np.int64).sum())
+    return total
 
 
 def build_x_slabs(spec: BlockSpec, perm_src, h):

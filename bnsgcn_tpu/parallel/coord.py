@@ -130,7 +130,7 @@ class _KVStore:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._data: dict[str, tuple[str, float]] = {}
+        self._data: dict[str, tuple[str, float]] = {}  # guarded-by: self._lock
 
     def put(self, key: str, value: str):
         with self._lock:

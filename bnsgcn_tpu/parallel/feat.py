@@ -177,6 +177,7 @@ def param_specs_for(spec, T: int, params_abs=None):
     if params_abs is None:
         from bnsgcn_tpu.models.gnn import init_params
         params_abs = jax.eval_shape(
+            # graftlint: disable=prng-literal-key(eval_shape only: the key never materializes)
             lambda: init_params(jax.random.key(0), spec))[0]
     return match_partition_rules(gnn_partition_rules(spec, T), params_abs)
 

@@ -273,9 +273,9 @@ class _Watchdog(threading.Thread):
         # first-call eval compile, a multi-GB checkpoint fsync) must clear
         # it — the quarry is hung collectives, which are minutes-to-forever
         self.min_s = float(os.environ.get("BNSGCN_WATCHDOG_MIN_S", 300))
-        self._durs: list[float] = []
-        self._last_beat = time.monotonic()
-        self._epoch = -1
+        self._durs: list[float] = []            # guarded-by: self._lock
+        self._last_beat = time.monotonic()      # guarded-by: self._lock
+        self._epoch = -1                        # guarded-by: self._lock
         self._halt = threading.Event()
         self._lock = threading.Lock()
 
@@ -311,6 +311,11 @@ class _Watchdog(threading.Thread):
     def run(self):
         last_alive = 0.0
         while not self._halt.wait(self.POLL_S):
+            # one consistent snapshot per poll; beat()/touch() write these
+            # from the main thread under the same lock
+            with self._lock:
+                epoch = self._epoch
+                last_beat = self._last_beat
             if self.coord is not None:
                 # alive-beat from THIS thread: proves the process is up even
                 # while the main thread is stuck inside a collective —
@@ -320,19 +325,20 @@ class _Watchdog(threading.Thread):
                 if now - last_alive >= self.ALIVE_BEAT_S:
                     last_alive = now
                     try:
-                        self.coord.heartbeat(self._epoch,
-                                             self.coord.ALIVE_KEY)
+                        self.coord.heartbeat(epoch, self.coord.ALIVE_KEY)
                     except Exception:
                         pass        # best-effort; never kills the watchdog
-            idle = time.monotonic() - self._last_beat
+            idle = time.monotonic() - last_beat
             deadline = self.deadline_s()
             if idle <= deadline:
                 continue
             # the dump runs in its OWN daemon thread with a bounded join:
             # the 77 exit fires exactly when a wedged disk/NFS may block
             # any file write (or the obs writer lock) forever, and the
-            # escape hatch must stay reachable regardless
-            t = threading.Thread(target=self._dump, args=(idle, deadline),
+            # escape hatch must stay reachable regardless. The epoch rides
+            # along as an argument — the dump thread must not need the lock.
+            t = threading.Thread(target=self._dump,
+                                 args=(idle, deadline, epoch),
                                  name="bnsgcn-watchdog-dump", daemon=True)
             t.start()
             t.join(timeout=30.0)
@@ -341,12 +347,12 @@ class _Watchdog(threading.Thread):
                                  "filesystem?); exiting without it\n")
             os._exit(EXIT_WATCHDOG)
 
-    def _dump(self, idle: float, deadline: float):
+    def _dump(self, idle: float, deadline: float, epoch: int):
         try:
             sys.stderr.write(
                 "\n[watchdog] step hung: no step-boundary heartbeat for "
                 f"{idle:.1f}s (deadline {deadline:.1f}s, last epoch "
-                f"{self._epoch}); dumping stacks and exiting "
+                f"{epoch}); dumping stacks and exiting "
                 f"{EXIT_WATCHDOG}\n")
             faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
             try:
@@ -377,10 +383,10 @@ class _Watchdog(threading.Thread):
                 # stderr alone dies with the terminal scrollback. "" =
                 # write failed (disk full): no breadcrumb to a ghost file
                 dump_path = obs_mod.write_postmortem(
-                    self.postmortem_dir, f"watchdog_E{self._epoch}",
+                    self.postmortem_dir, f"watchdog_E{epoch}",
                     text=(f"watchdog: no step-boundary heartbeat for "
                           f"{idle:.1f}s (deadline {deadline:.1f}s, last "
-                          f"epoch {self._epoch}); exiting "
+                          f"epoch {epoch}); exiting "
                           f"{EXIT_WATCHDOG}"),
                     registry=(self.obs.registry
                               if self.obs is not None else None))
@@ -392,7 +398,7 @@ class _Watchdog(threading.Thread):
                 # nor a writer lock held by a disk-stalled main thread may
                 # cost (or deadlock) the exit this event reports
                 try:
-                    self.obs.emit_bounded("watchdog_fire", epoch=self._epoch,
+                    self.obs.emit_bounded("watchdog_fire", epoch=epoch,
                                           idle_s=round(idle, 1),
                                           deadline_s=round(deadline, 1),
                                           dump=dump_path or None)

@@ -16,6 +16,7 @@ measured by a compiled exchange-only microbench on identical inputs.
 
 from __future__ import annotations
 
+import contextlib
 import math
 import os
 import shutil
@@ -33,6 +34,7 @@ import numpy as np
 from bnsgcn_tpu import checkpoint as ckpt
 from bnsgcn_tpu import obs as obs_mod
 from bnsgcn_tpu import resilience
+from bnsgcn_tpu import strict as strict_mod
 from bnsgcn_tpu.config import Config, ConfigError
 from bnsgcn_tpu.data.artifacts import (PartitionArtifacts, build_artifacts,
                                        load_artifacts, save_artifacts)
@@ -43,6 +45,7 @@ from bnsgcn_tpu.evaluate import evaluate_induc, evaluate_mesh, evaluate_trans
 from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
 from bnsgcn_tpu.parallel import coord as coord_mod
 from bnsgcn_tpu.parallel import feat as feat_mod
+from bnsgcn_tpu.parallel.mesh import replicated_sharding
 from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc
 from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns, init_training,
                                 local_part_ids, param_global_norm, place_blocks,
@@ -706,9 +709,18 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         deterministically re-diverging. nonce 0 — every run that never
         rolled back — is the historical keys, bit-identical."""
         if nonce:
-            return (jax.random.fold_in(base_sample_key, nonce),
-                    jax.random.fold_in(base_drop_key, nonce))
-        return base_sample_key, base_drop_key
+            sk, dk = (jax.random.fold_in(base_sample_key, nonce),
+                      jax.random.fold_in(base_drop_key, nonce))
+        else:
+            sk, dk = base_sample_key, base_drop_key
+        if cfg.strict_exec and jax.process_count() == 1:
+            # --strict-exec: commit the keys to the mesh up front. The
+            # transfer guard treats the lazy first-use resharding of an
+            # uncommitted host-born array as an implicit transfer, so the
+            # one-time placement happens here, outside any guarded step.
+            sh = replicated_sharding(mesh)
+            sk, dk = jax.device_put(sk, sh), jax.device_put(dk, sh)
+        return sk, dk
 
     sample_key, drop_key = _fold_keys(retry_nonce)
 
@@ -840,6 +852,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                                 # resume point (newer ckpts all corrupt) rebases
                                 # the list instead of corrupting its indexing
     epoch = start_epoch
+    # --strict-exec: runtime proof the steady-state step is clean — a
+    # transfer guard around every step (implicit host transfer = error)
+    # plus a compile listener (recompile after a variant's first guarded
+    # step = error). The loss fetch goes through strict.fetch (audited
+    # explicit device_get); the per-epoch uint32 upload is hoisted before
+    # the guard below.
+    strict = strict_mod.StrictExec(obs=obs, log=log) if cfg.strict_exec \
+        else None
     # --halo-refresh cache state: None means the next step runs the
     # full-refresh geometry and rebuilds the cache. Starts invalid (fresh run
     # OR resume — checkpoints never hold the cache) and is re-invalidated at
@@ -898,33 +918,51 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 jax.profiler.start_trace(trace_dir)
                 tracing = True
             t0 = time.perf_counter()
-            if use_refresh:
-                # --halo-refresh K: an invalidated cache (run start, resume,
-                # rollback) forces one full-refresh epoch at peak wire cost;
-                # every other epoch runs the ~1/K partial exchange against
-                # the cache. The cache is never checkpointed — it is
-                # host-held device state only, rebuilt by the next
-                # full-refresh epoch after any restore.
-                refresh_full = halo_cache is None
-                if refresh_full:
-                    params, state, opt_state, loss, halo_cache = (
-                        fns.train_step_full(
-                            params, state, opt_state, jnp.uint32(epoch), blk,
-                            tables, sample_key, drop_key))
+            # --halo-refresh K: an invalidated cache (run start, resume,
+            # rollback) forces one full-refresh epoch at peak wire cost;
+            # every other epoch runs the ~1/K partial exchange against
+            # the cache. The cache is never checkpointed — it is
+            # host-held device state only, rebuilt by the next
+            # full-refresh epoch after any restore. full/cached are two
+            # distinct compiled programs, so each is its own strict-exec
+            # variant.
+            refresh_full = use_refresh and halo_cache is None
+            variant = (("full" if refresh_full else "cached")
+                       if use_refresh else "step")
+            # the one deliberate per-epoch host->device upload, hoisted
+            # BEFORE the strict guard: everything else the step consumes
+            # is already device-resident. Under strict the scalar is also
+            # committed to the mesh's replicated sharding here — otherwise
+            # its first use inside the guarded step reshards it and the
+            # guard flags that device-to-device move.
+            epoch_dev = jnp.uint32(epoch)
+            if strict is not None and jax.process_count() == 1:
+                epoch_dev = jax.device_put(epoch_dev,
+                                           replicated_sharding(mesh))
+            with (strict.step(variant) if strict is not None
+                  else contextlib.nullcontext()):
+                if use_refresh:
+                    if refresh_full:
+                        params, state, opt_state, loss, halo_cache = (
+                            fns.train_step_full(
+                                params, state, opt_state, epoch_dev, blk,
+                                tables, sample_key, drop_key))
+                    else:
+                        params, state, opt_state, loss, halo_cache = (
+                            fns.train_step_cached(
+                                params, state, opt_state, epoch_dev, blk,
+                                tables_refresh_d, halo_cache, sample_key,
+                                drop_key))
                 else:
-                    params, state, opt_state, loss, halo_cache = (
-                        fns.train_step_cached(
-                            params, state, opt_state, jnp.uint32(epoch), blk,
-                            tables_refresh_d, halo_cache, sample_key,
-                            drop_key))
-            else:
-                refresh_full = False
-                params, state, opt_state, loss = fns.train_step(
-                    params, state, opt_state, jnp.uint32(epoch), blk, tables,
-                    sample_key, drop_key)
-            loss.block_until_ready()
+                    params, state, opt_state, loss = fns.train_step(
+                        params, state, opt_state, epoch_dev, blk, tables,
+                        sample_key, drop_key)
+                loss.block_until_ready()
             dt = time.perf_counter() - t0
-            loss_f = float(loss)
+            # identical float either way; under strict the fetch is the
+            # audited explicit path (counted in the end-of-run summary)
+            loss_f = (float(strict.fetch(loss)) if strict is not None
+                      else float(loss))
             usr1_in_step = usr1_tracing     # profiler overhead rides dt
             if use_refresh and refresh_full:
                 # lifecycle marker: this epoch rebuilt the halo cache at peak
@@ -1304,6 +1342,10 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         if resil is not None:
             res.rollbacks = list(resil.rollbacks)
             resil.close()
+        if strict is not None:
+            # the audit summary must land (log + obs event) on EVERY exit
+            # path — an interrupted strict run still proves what it proved
+            strict.finish()
         if obs is not None and sys.exc_info()[0] is not None:
             # an interrupted run (preempt 75, divergence 76, abort 78 —
             # anything raising out of the loop) still ends its log with a

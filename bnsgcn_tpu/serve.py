@@ -313,9 +313,9 @@ class _TierBBatcher:
         self._score_fn = score_fn
         self.max_batch = max(int(max_batch), 1)
         self.window_s = window_s
-        self._pending: list[tuple[int, dict, threading.Event]] = []
+        self._pending: list[tuple[int, dict, threading.Event]] = []  # guarded-by: self._cv
         self._cv = threading.Condition()
-        self._stop = False
+        self._stop = False      # guarded-by: self._cv
         self.batches = 0
         self.batched_requests = 0
         self._thread = threading.Thread(target=self._run,
@@ -408,17 +408,20 @@ class ServeCore:
         self._lat = {t: self.registry.histogram(f"serve/latency_ms/{t}")
                      for t in ("A", "B")}
         self._lag_hist = self.registry.histogram("serve/refresh_lag_s")
-        self._dirty_since: dict[int, float] = {}    # node -> first dirty ts
+        # node -> first dirty ts        # guarded-by: self._lock
+        self._dirty_since: dict[int, float] = {}
         self.scorer = SubgraphScorer(spec, edge_chunk=cfg.edge_chunk)
-        self.dirty: set[int] = set()
-        self._refreshing: set[int] = set()  # claimed by an in-flight refresh
+        self.dirty: set[int] = set()        # guarded-by: self._lock
+        self._refreshing: set[int] = set()  # guarded-by: self._lock
+                                        # claimed by an in-flight refresh
                                         # step: still stale for tier routing,
                                         # but never double-picked (the
                                         # background refresher and a client
                                         # 'flush' must not score the same
                                         # nodes twice)
-        self.deltas: list[dict] = []
+        self.deltas: list[dict] = []        # guarded-by: self._lock
         self._lock = threading.RLock()
+        # guarded-by: self._lock
         self.stats = {"requests": 0, "tier_a": 0, "tier_b": 0,
                       "refreshed_nodes": 0, "deltas": 0}
         self.batcher = _TierBBatcher(self._score_batch, cfg.serve_max_batch)
@@ -545,7 +548,7 @@ class ServeCore:
 
     # -- delta ingestion --
 
-    def _mark_dirty_stamps(self, new_dirty: set):
+    def _mark_dirty_stamps_locked(self, new_dirty: set):
         """First-dirty timestamps for the refresh-lag figure (setdefault:
         a node already waiting keeps its ORIGINAL staleness clock)."""
         now = time.monotonic()
@@ -559,7 +562,7 @@ class ServeCore:
             new_dirty = self.graph.forward_closure(touched, self.hops)
             added = new_dirty - self.dirty
             self.dirty |= new_dirty
-            self._mark_dirty_stamps(new_dirty)
+            self._mark_dirty_stamps_locked(new_dirty)
             self.deltas.append({"op": "add_edges",
                                 "edges": [[u, v] for u, v in pairs]})
             self.stats["deltas"] += 1
@@ -579,7 +582,7 @@ class ServeCore:
             new_dirty = self.graph.forward_closure(touched, self.hops)
             added = new_dirty - self.dirty
             self.dirty |= new_dirty
-            self._mark_dirty_stamps(new_dirty)
+            self._mark_dirty_stamps_locked(new_dirty)
             self.deltas.append({"op": "update_feat", "node": int(node),
                                 "feat": np.asarray(
                                     vec, dtype=np.float32).tolist()})
@@ -619,6 +622,7 @@ class ServeCore:
                 busy = not self.dirty       # only claims in flight elsewhere
             if busy:
                 time.sleep(0.005)           # let the owning step finish
+        # graftlint: disable=lock-unguarded-access(best-effort count in a timeout message; a torn read costs nothing)
         raise TimeoutError(f"flush: {len(self.dirty)} nodes still dirty")
 
     # -- resumable delta log --
@@ -711,8 +715,8 @@ class ServeServer:
                  log=print):
         self.core = core
         self.log = log
-        self._inflight = 0
-        self._draining = False
+        self._inflight = 0      # guarded-by: self._lock
+        self._draining = False  # guarded-by: self._lock
         self._lock = threading.Lock()
         self.shutdown_requested = threading.Event()
         self.server = coord_mod.LineJsonServer(port, self._handle,

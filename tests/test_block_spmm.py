@@ -355,3 +355,52 @@ def test_max_row_dense_repair_matches_build():
     # already-filled specs pass through untouched
     pf, pb = repair_max_row_dense(fwd, bwd, arrays)
     assert pf is fwd and pb is bwd
+
+
+def test_dense_edge_count_split_and_missing_keys():
+    """dense_edge_count across all three layout shapes (bench preflight
+    regression: the hybrid+rag+ovl candidate KeyError'd on the split
+    layout's int_/fro_-prefixed tile stacks and fell back to ell, so +ovl
+    never got measured).
+
+    * unified layout: bare blk_tiles_fwd
+    * split-overlap layout: int_blk_tiles_fwd + fro_blk_tiles_fwd
+    * fully-ELL layout (occupancy filter kept nothing): no tiles keys -> 0
+    """
+    from bnsgcn_tpu.ops.block_spmm import build_split_block_layouts
+
+    g = sbm_graph(n_nodes=240, n_class=4, n_feat=5, p_in=0.2, p_out=0.01,
+                  seed=17)
+    art = build_artifacts(g, partition_graph(g, 2, method="random", seed=2))
+    # unified layout counts == per-part tile sums (sanity baseline)
+    _, _, _, uni = _hybrid_for(art, occupancy_min=4, tile=32)
+    assert "blk_tiles_fwd" in uni
+    for p in range(art.n_parts):
+        assert dense_edge_count(uni, p) == int(
+            uni["blk_tiles_fwd"][p].astype(np.int64).sum())
+
+    # split layout: keys are int_/fro_-prefixed; the old implementation
+    # raised KeyError here
+    perms_i = np.stack([cluster_order(art.src[p], art.dst[p], art.pad_inner,
+                                      art.n_ext, target=32)[0]
+                        for p in range(art.n_parts)])
+    perms_e = np.stack([cluster_order(art.src[p], art.dst[p], art.pad_inner,
+                                      art.n_ext, target=32)[1]
+                        for p in range(art.n_parts)])
+    _, _, split_arrays, _, _ = build_split_block_layouts(
+        art.src, art.dst, art.pad_inner, art.n_ext, perms_i, perms_e,
+        occupancy_min=4, tile_r=32, tile_c=32)
+    assert "blk_tiles_fwd" not in split_arrays
+    for p in range(art.n_parts):
+        want = sum(int(split_arrays[k][p].astype(np.int64).sum())
+                   for k in ("int_blk_tiles_fwd", "fro_blk_tiles_fwd")
+                   if k in split_arrays)
+        got = dense_edge_count(split_arrays, p)
+        assert got == want and got >= 0
+
+    # impossible occupancy keeps only a placeholder tile carrying 0 edges
+    _, _, _, empty = _hybrid_for(art, occupancy_min=10**9, tile=32)
+    assert dense_edge_count(empty) == 0
+    # arrays with no tiles keys at all (the auto path drops empty stacks
+    # from extra_blk, test_spmm_auto_resolution) -> 0, not KeyError
+    assert dense_edge_count({"merge_perm": np.arange(4)}) == 0

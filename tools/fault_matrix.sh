@@ -56,6 +56,14 @@ check_event() {  # check_event <stage> <obs_log> <kind>
   fi
 }
 
+echo "== graftlint: the repo must be static-analysis clean =="
+# hazards the matrix exercises at runtime (deadlock-prone collectives,
+# exit-code drift, unguarded shared state) are exactly what the lint
+# proves absent from the source first; a dirty tree fails the matrix
+# before any training run spends time
+bash tools/lint.sh -q > "$WORK/lint.log" 2>&1
+check lint 0 $?
+
 echo "== uninterrupted reference run =="
 python -m bnsgcn_tpu.main $BASE --ckpt-path "$WORK/ck_ref" \
   --obs-log "$WORK/obs_ref.jsonl" > "$WORK/ref.log" 2>&1

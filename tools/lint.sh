@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# tools/lint.sh — the graftlint CI gate.
+#
+# Runs the repo-native static-analysis suite over the default lint
+# surface (bnsgcn_tpu/, tools/, bench.py, __graft_entry__.py) and writes
+# the machine-readable report to tools/lint_report.json (override with
+# LINT_REPORT=path). Exit code: 0 clean, 1 findings, 2 parse errors —
+# straight from `python -m bnsgcn_tpu.analysis`.
+#
+# Usage:
+#   tools/lint.sh                  # full default surface
+#   tools/lint.sh bnsgcn_tpu/run.py  # specific files/dirs
+#   LINT_REPORT=/tmp/r.json tools/lint.sh
+set -u
+cd "$(dirname "$0")/.."
+
+REPORT="${LINT_REPORT:-tools/lint_report.json}"
+PY="${PYTHON:-python}"
+
+# The linter is pure-AST (no jax import), but keep the env pinned the
+# same way the test tier does so any future runtime hook stays CPU-safe.
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
+    "$PY" -m bnsgcn_tpu.analysis --json "$REPORT" "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "lint.sh: graftlint gate FAILED (rc=$rc, report: $REPORT)" >&2
+fi
+exit "$rc"

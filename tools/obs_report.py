@@ -43,11 +43,16 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from bnsgcn_tpu.obs import load_events  # noqa: E402  (stdlib-only import)
+from bnsgcn_tpu.obs import EVENT_KINDS, load_events  # noqa: E402
 
 LIFECYCLE_KINDS = ("inject", "rollback", "preempt", "watchdog_fire",
                    "divergence_abort", "coord_decision", "profile_request",
-                   "profile", "halo_refresh")
+                   "profile", "halo_refresh", "strict_exec")
+
+# the report's sub-vocabularies must stay inside the bus registry —
+# graftlint checks the emit sites, this checks the reader
+assert set(LIFECYCLE_KINDS) <= set(EVENT_KINDS), \
+    sorted(set(LIFECYCLE_KINDS) - set(EVENT_KINDS))
 
 
 def load_run(paths: list[str]) -> list[dict]:
@@ -75,9 +80,13 @@ def summarize(events: list[dict]) -> dict:
     """Structured digest of one run's events (the --json output)."""
     out: dict = {"header": None, "epochs": {}, "evals": {}, "lifecycle": [],
                  "epoch_ranks": [], "serve": None, "serve_header": None,
-                 "run_end": None, "traces": [], "bench": []}
+                 "run_end": None, "traces": [], "bench": [],
+                 "unknown_kinds": {}}
     for ev in events:
         k = ev.get("kind")
+        if k is not None and k not in EVENT_KINDS:
+            # a log written by a newer/older build: surface, don't drop
+            out["unknown_kinds"][k] = out["unknown_kinds"].get(k, 0) + 1
         if k == "run_header" and out["header"] is None:
             out["header"] = ev
         elif k == "epoch":
@@ -129,6 +138,10 @@ def _elide(rows, head=20, tail=15):
 
 
 def render(s: dict, write=print):
+    if s.get("unknown_kinds"):
+        write("WARNING: event kinds outside obs.EVENT_KINDS (build skew?): "
+              + " ".join(f"{k}x{n}"
+                         for k, n in sorted(s["unknown_kinds"].items())))
     hdr = s["header"]
     if hdr is not None:
         cfg = hdr.get("config", {})

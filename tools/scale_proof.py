@@ -341,6 +341,7 @@ def main():
     print(f"[{time.time()-t0:7.1f}s] device data + precompute done "
           f"(rss {rss_gb():.1f} GB)", flush=True)
 
+    # graftlint: disable=prng-literal-key(fixed seed: scale proof must be reproducible across pod windows)
     params, state = init_params(jax.random.key(0), spec, dtype=jnp.bfloat16)
     params = place_replicated(params, mesh)
     state = place_replicated(state, mesh)
@@ -348,6 +349,7 @@ def main():
     t1 = time.time()
     params, state, opt, loss = fns.train_step(
         params, state, opt, jnp.uint32(0), blk, tables_d,
+        # graftlint: disable=prng-literal-key(scale proof times fixed streams; independence is irrelevant)
         jax.random.key(0), jax.random.key(1))
     l0 = float(loss)
     print(f"[{time.time()-t0:7.1f}s] epoch 0 (incl compile): "
@@ -355,6 +357,7 @@ def main():
     t1 = time.time()
     params, state, opt, loss = fns.train_step(
         params, state, opt, jnp.uint32(1), blk, tables_d,
+        # graftlint: disable=prng-literal-key(scale proof times fixed streams; independence is irrelevant)
         jax.random.key(0), jax.random.key(1))
     l1 = float(loss)
     print(f"[{time.time()-t0:7.1f}s] epoch 1 (steady): {time.time()-t1:.1f}s "

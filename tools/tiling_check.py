@@ -21,7 +21,9 @@ t0 = time.time()
 fwd, bwd, ell_pair, arrays = build_block_layouts(
     art.src, art.dst, art.pad_inner, art.n_ext, pi[None], pe[None])
 dc = dense_edge_count(arrays)
-B = arrays["blk_tiles_fwd"].shape[1]
+# a graph whose occupancy filter keeps no dense tiles omits the key
+bt = arrays.get("blk_tiles_fwd")
+B = bt.shape[1] if bt is not None else 0
 log(f"tiling {time.time()-t0:.0f}s: {dc/1e6:.1f}M / {g.n_edges/1e6:.1f}M edges dense "
     f"({dc/g.n_edges:.1%}), {B} tiles ({B*TR*TC/1e9:.2f} GB int8), "
     f"avg occupancy {dc/max(B,1)/(TR*TC):.1%}")
