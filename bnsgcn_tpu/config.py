@@ -114,6 +114,19 @@ class Config:
                                         # 256 = 4x more tiles per budget byte, finer
                                         # edge capture on clustered graphs at ~2x the
                                         # slab-gather traffic per tile byte)
+    reorder: str = "off"                # graph-reordering artifact pass
+                                        # (data/reorder.py): 'cluster' permutes
+                                        # each part's inner rows ONCE at load
+                                        # (degree-anchored label propagation +
+                                        # FFD tile packing) so edge mass
+                                        # concentrates into dense MXU tiles;
+                                        # 'auto' applies it only when measured
+                                        # tile coverage improves; 'off' is the
+                                        # bit-identical pre-reorder pipeline.
+                                        # Results stay in global id order (the
+                                        # permuted global_nid inverts at every
+                                        # user-visible edge); the order is
+                                        # cached like layouts under --cache-dir
     profile_dir: str = ""               # write a jax.profiler trace of a few epochs here
     comm_trace: bool = True             # auto-trace a short post-warmup window and report
                                         # trace-derived in-step Comm/Reduce columns
@@ -421,6 +434,14 @@ def create_parser() -> argparse.ArgumentParser:
     both("block-occupancy", type=int, default=0)
     both("block-tile-budget-mb", type=int, default=2048)
     both("block-tile", type=int, default=512)
+    p.add_argument("--reorder", type=str, default="off",
+                   choices=["auto", "cluster", "off"],
+                   help="graph-reordering artifact pass: permute each "
+                        "part's rows once at load to concentrate edge mass "
+                        "into dense MXU tiles (order cached like layouts; "
+                        "outputs stay in global id order; 'auto' applies "
+                        "only on measured coverage improvement; 'off' is "
+                        "bit-identical)")
     both("ckpt-path", type=str, default="./checkpoint/")
     both("results-path", type=str, default="./results/")
     p.add_argument("--resume", action="store_true")

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bnsgcn_tpu.ops.ell import (ELL_SPLIT_CAP, GeoAccum, build_layouts,
-                                make_ell_spmm, run_parallel)
+                                layout_fastpath, make_ell_spmm, run_parallel)
 
 TR = 512          # default dst rows per dense tile (square: transposes keep
 TC = 512          # shape, and per-edge slab/output overhead beats narrow
@@ -79,12 +79,29 @@ def effective_occupancy(occupancy: int, tile_r: int = TR,
 
 
 def _select_dense(tile_id, occupancy_min, tile_budget_bytes,
-                  tile_bytes=TR * TC, need_inverse=True):
+                  tile_bytes=TR * TC, need_inverse=True, n_tiles=None):
     """Which tiles densify: >= occupancy_min edges, highest-count tiles win
     under the HBM budget (ties trimmed last). Shared by the real layout
     build and the O(E) coverage estimator behind --spmm auto (which skips
-    the len(E) int64 inverse array — need_inverse=False)."""
-    if need_inverse:
+    the len(E) int64 inverse array — need_inverse=False).
+
+    With `n_tiles` (the dense tile-grid extent) the unique pass runs as one
+    O(E + n_tiles) bincount + rank LUT instead of np.unique's O(E log E)
+    sort — bitwise-identical output (bincount indices are ascending, the
+    same order np.unique emits; ~24x at 20M edges). The sort fallback
+    covers grids too large to histogram and BNSGCN_LAYOUT_FASTPATH=0."""
+    if (n_tiles is not None and layout_fastpath()
+            and n_tiles <= (1 << 26)):
+        cf = np.bincount(tile_id, minlength=n_tiles)
+        uniq = np.flatnonzero(cf)
+        counts = cf[uniq]
+        if need_inverse:
+            lut = np.zeros(n_tiles, dtype=np.int64)
+            lut[uniq] = np.arange(len(uniq))
+            inv = lut[tile_id]
+        else:
+            inv = None
+    elif need_inverse:
         uniq, inv, counts = np.unique(tile_id, return_inverse=True,
                                       return_counts=True)
     else:
@@ -122,10 +139,12 @@ def estimate_coverage(perm_rows, perm_cols, n_rows, n_src, rows, cols,
     n_cb = (n_src + tile_c - 1) // tile_c
     tile_id = (perm_rows[rows] // tile_r).astype(np.int64) * n_cb \
         + perm_cols[cols] // tile_c
+    n_rb = (n_rows + tile_r - 1) // tile_r
     _, _, counts, dense_sel = _select_dense(tile_id, occupancy_min,
                                             tile_budget_bytes,
                                             tile_bytes=tile_r * tile_c,
-                                            need_inverse=False)
+                                            need_inverse=False,
+                                            n_tiles=n_rb * n_cb)
     return float(counts[dense_sel].sum()) / float(len(rows))
 
 
@@ -141,23 +160,29 @@ def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
     the total dense storage stays under tile_budget_bytes (highest-count
     tiles win; ties trimmed last).
     Returns (tiles int8 [B,tile_r,tile_c] sorted by row_blk, row_blk,
-    col_blk, residual_edge_mask, extra_rows, extra_cols) — the extras are
-    >127 multiplicity overflow in PERMUTED coordinates. Tiles fill by a
+    col_blk, residual_edge_mask, extra_rows, extra_cols, rle) — the extras
+    are >127 multiplicity overflow in PERMUTED coordinates. Tiles fill by a
     cell-id sort + run-length encode (writes only occupied cells); peak
-    transient memory is O(E), not O(tiles)."""
+    transient memory is O(E), not O(tiles). `rle` is the occupied-cell
+    encoding (cell ids, clamped int8 counts) on the fast path (None on
+    legacy) — it lets the caller build the transposed bwd stack and the
+    per-row dense maxima by O(occupied) scatter/bincount instead of three
+    more passes over the multi-GB stack."""
     n_cb = (n_src + tile_c - 1) // tile_c
     pr = perm_rows[rows]
     pc = perm_cols[cols]
     tile_id = (pr // tile_r).astype(np.int64) * n_cb + pc // tile_c
+    n_rb = (n_rows + tile_r - 1) // tile_r
     uniq, inv, counts, dense_sel = _select_dense(tile_id, occupancy_min,
                                                  tile_budget_bytes,
-                                                 tile_bytes=tile_r * tile_c)
+                                                 tile_bytes=tile_r * tile_c,
+                                                 n_tiles=n_rb * n_cb)
     B = int(dense_sel.sum())
     if B == 0:
         return (np.zeros((0, tile_r, tile_c), np.int8),
                 np.zeros(0, np.int32),
                 np.zeros(0, np.int32), np.ones(len(rows), dtype=bool),
-                np.zeros(0, np.int64), np.zeros(0, np.int64))
+                np.zeros(0, np.int64), np.zeros(0, np.int64), None)
 
     rank = np.full(len(uniq), -1, dtype=np.int64)
     rank[np.nonzero(dense_sel)[0]] = np.arange(B)        # uniq sorted => rb-major
@@ -184,7 +209,8 @@ def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
         [[0], np.flatnonzero(np.diff(cell)) + 1]).astype(np.int64)
     uc = cell[starts]                                    # occupied cells
     cnt = np.diff(np.concatenate([starts, [len(cell)]]))
-    tiles8.reshape(-1)[uc] = np.minimum(cnt, 127).astype(np.int8)
+    cnt8 = np.minimum(cnt, 127).astype(np.int8)
+    tiles8.reshape(-1)[uc] = cnt8
     over = cnt > 127                                     # int8 overflow:
     if over.any():                                       # excess -> residual
         rep = cnt[over] - 127
@@ -197,7 +223,8 @@ def _build_tiles(perm_rows, perm_cols, n_rows, n_src, rows, cols,
                                rep)
     else:
         extra_rows = extra_cols = np.zeros(0, np.int64)
-    return tiles8, row_blk, col_blk, resid_mask, extra_rows, extra_cols
+    rle = (uc, cnt8) if layout_fastpath() else None
+    return tiles8, row_blk, col_blk, resid_mask, extra_rows, extra_cols, rle
 
 
 def _row_dense_maxima(tiles, rb, cb, n_dst, n_src_ext, tile_r, tile_c):
@@ -258,14 +285,22 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
     def one_part(p):
         real = dst_all[p] < n_dst
         s, d = src_all[p][real], dst_all[p][real]
-        tiles, rb, cb, resid, xr, xc = _build_tiles(
+        tiles, rb, cb, resid, xr, xc, rle = _build_tiles(
             perm_inner[p], perm_ext[p], n_dst, n_src_ext, d, s, occupancy_min,
             tile_budget_bytes, tile_r=tile_r, tile_c=tile_c)
         # excess-multiplicity edges come back in PERMUTED coordinates —
-        # map to original ids for the residual ELL
-        orig_inner = np.argsort(perm_inner[p], kind="stable")
-        orig_ext = np.argsort(perm_ext[p], kind="stable")
-        return ((tiles, rb, cb),
+        # map to original ids for the residual ELL. perm_* are true
+        # permutations, so the inverse is a single scatter (~8x vs the
+        # legacy argsort; same values).
+        if layout_fastpath():
+            orig_inner = np.empty(n_dst, dtype=np.intp)
+            orig_inner[perm_inner[p]] = np.arange(n_dst)
+            orig_ext = np.empty(n_src_ext, dtype=np.intp)
+            orig_ext[perm_ext[p]] = np.arange(n_src_ext)
+        else:
+            orig_inner = np.argsort(perm_inner[p], kind="stable")
+            orig_ext = np.argsort(perm_ext[p], kind="stable")
+        return ((tiles, rb, cb, rle),
                 np.concatenate([s[resid], orig_ext[xc]]),
                 np.concatenate([d[resid], orig_inner[xr]]))
 
@@ -281,11 +316,26 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
     # runs per part under shard_map, so the per-part max is the bound):
     # caps the int8 Pallas accumulator at 127*127*max_row_dense
     mrd_f = mrd_b = 0
-    for p, (tiles, rb, cb) in enumerate(per_part):
+    area = tile_r * tile_c
+    for p, (tiles, rb, cb, rle) in enumerate(per_part):
         if tiles.shape[0] == 0:
             continue
-        m_f, m_b = _row_dense_maxima(tiles, rb, cb, n_dst, n_src_ext,
-                                     tile_r, tile_c)
+        if rle is not None:
+            # O(occupied cells) bincount over the RLE — same clamped int8
+            # counts the stack stores, grouped by the same (block, lane)
+            # keys _row_dense_maxima sums, so the maxima are identical
+            # without two more full passes over the multi-GB stack
+            uc, c8 = rle
+            t = uc // area
+            r = (uc % area) // tile_c
+            c = uc % tile_c
+            m_f = int(np.bincount(rb[t].astype(np.int64) * tile_r + r,
+                                  weights=c8).max())
+            m_b = int(np.bincount(cb[t].astype(np.int64) * tile_c + c,
+                                  weights=c8).max())
+        else:
+            m_f, m_b = _row_dense_maxima(tiles, rb, cb, n_dst, n_src_ext,
+                                         tile_r, tile_c)
         mrd_f, mrd_b = max(mrd_f, m_f), max(mrd_b, m_b)
     # residual geometry stats (mergeable across hosts)
     acc_f, acc_b = GeoAccum(ELL_SPLIT_CAP), GeoAccum(ELL_SPLIT_CAP)
@@ -303,23 +353,75 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
     res_geometry = {"fwd": acc_f.finish(), "bwd": acc_b.finish()}
     n_rb_f = (n_dst + tile_r - 1) // tile_r
     n_rb_b = (n_src_ext + tile_c - 1) // tile_c
-    tiles_f = np.zeros((P, B, tile_r, tile_c), dtype=np.int8)
+
+    def build_residual():
+        # residual ELL over the leftover edges (shared fwd+bwd edge set)
+        e_max = max(max((len(s) for s in res_src), default=0), 8)
+        e_max = ((e_max + 7) // 8) * 8
+        r_src = np.zeros((P, e_max), dtype=np.int32)
+        r_dst = np.full((P, e_max), n_dst, dtype=np.int32)
+        for p in range(P):
+            k = len(res_src[p])
+            r_src[p, :k] = res_src[p]
+            r_dst[p, :k] = res_dst[p]
+            res_src[p] = res_dst[p] = None
+        return build_layouts(r_src, r_dst, n_dst, n_src_ext,
+                             geometry=res_geometry)
+
+    def build_stacks():
+        nonlocal tiles_f
+        if P == 1 and per_part[0][0].shape[0] == B:
+            # single local part fills the stack exactly: alias instead of
+            # a second 2+ GB copy (the fwd stack IS the part's tile stack)
+            tiles_f = per_part[0][0][None]
+        else:
+            tiles_f = np.zeros((P, B, tile_r, tile_c), dtype=np.int8)
+        for p in range(P):
+            tiles, rb, cb, rle = per_part[p]
+            bp = tiles.shape[0]
+            if bp:
+                if tiles_f.base is not tiles:
+                    tiles_f[p, :bp] = tiles
+                rowb_f[p, :bp] = rb
+                colb_f[p, :bp] = cb
+                # transpose: bwd tile (cb,rb) = fwd tile (rb,cb)^T, cb-sorted
+                o = np.argsort(cb, kind="stable")
+                if rle is not None:
+                    # write the transposed stack straight from the occupied-
+                    # cell RLE: O(occupied) scatter vs fancy-indexing +
+                    # assigning a strided transpose of the whole stack
+                    uc, c8 = rle
+                    t = uc // area
+                    r = (uc % area) // tile_c
+                    c = uc % tile_c
+                    pos_b = np.empty(bp, dtype=np.int64)
+                    pos_b[o] = np.arange(bp)
+                    tiles_b[p].reshape(-1)[pos_b[t] * area + c * tile_r
+                                           + r] = c8
+                else:
+                    tiles_b[p, :bp] = tiles[o].transpose(0, 2, 1)
+                rowb_b[p, :bp] = cb[o]
+                colb_b[p, :bp] = rb[o]
+            # release this part's stack as soon as it's copied (the P==1
+            # alias survives through tiles_f.base)
+            per_part[p] = None
+
+    tiles_f = None
     rowb_f = np.full((P, B), n_rb_f, dtype=np.int32)
     colb_f = np.zeros((P, B), dtype=np.int32)
     tiles_b = np.zeros((P, B, tile_c, tile_r), dtype=np.int8)
     rowb_b = np.full((P, B), n_rb_b, dtype=np.int32)
     colb_b = np.zeros((P, B), dtype=np.int32)
-    for p, (tiles, rb, cb) in enumerate(per_part):
-        bp = tiles.shape[0]
-        if bp:
-            tiles_f[p, :bp] = tiles
-            rowb_f[p, :bp] = rb
-            colb_f[p, :bp] = cb
-            # transpose: bwd tile (cb, rb) = fwd tile (rb, cb)^T, sorted by cb
-            o = np.argsort(cb, kind="stable")
-            tiles_b[p, :bp] = tiles[o].transpose(0, 2, 1)
-            rowb_b[p, :bp] = cb[o]
-            colb_b[p, :bp] = rb[o]
+    if layout_fastpath():
+        # residual ELL FIRST, while the per-part stacks are the only live
+        # multi-GB objects: with the assembled fwd+bwd stacks also resident
+        # the same build measures ~5x slower on a 1-vCPU host (page-table /
+        # TLB pressure from the extra GBs dominates its random gathers)
+        ell_fwd, ell_bwd, ell_arrays = build_residual()
+        build_stacks()
+    else:
+        build_stacks()
+        ell_fwd, ell_bwd, ell_arrays = build_residual()
 
     arrays = {
         "blk_tiles_fwd": tiles_f, "blk_rowb_fwd": rowb_f,
@@ -329,19 +431,6 @@ def build_block_layouts(src_all, dst_all, n_dst, n_src_ext, perm_inner,
         "blk_perm_ext": perm_ext.astype(np.int32),
         "blk_perm_inner": perm_inner.astype(np.int32),
     }
-
-    # residual ELL over the leftover edges (shared fwd+bwd edge set)
-    e_max = max(max((len(s) for s in res_src), default=0), 8)
-    e_max = ((e_max + 7) // 8) * 8
-    r_src = np.zeros((P, e_max), dtype=np.int32)
-    r_dst = np.full((P, e_max), n_dst, dtype=np.int32)
-    for p in range(P):
-        k = len(res_src[p])
-        r_src[p, :k] = res_src[p]
-        r_dst[p, :k] = res_dst[p]
-    ell_fwd, ell_bwd, ell_arrays = build_layouts(r_src, r_dst, n_dst,
-                                                 n_src_ext,
-                                                 geometry=res_geometry)
     for k, v in ell_arrays.items():
         arrays[f"res_{k}"] = v
 
@@ -362,9 +451,18 @@ def _compact_rank_perm(perm_full: np.ndarray, mask: np.ndarray,
     build's locality without re-clustering. Padded compact slots fill the
     remaining positions (each position used exactly once)."""
     rows = np.nonzero(mask)[0]
-    order = np.argsort(perm_full[rows], kind="stable")
-    rank = np.empty(len(rows), dtype=np.int64)
-    rank[order] = np.arange(len(rows))
+    vals = perm_full[rows]
+    if layout_fastpath():
+        # rank of each subset value = count of smaller subset values: one
+        # presence mask + cumsum over the full space, O(N) vs the argsort's
+        # O(S log S) — identical ranks (the values are distinct)
+        present = np.zeros(len(perm_full), dtype=bool)
+        present[vals] = True
+        rank = (np.cumsum(present) - 1)[vals]
+    else:
+        order = np.argsort(vals, kind="stable")
+        rank = np.empty(len(rows), dtype=np.int64)
+        rank[order] = np.arange(len(rows))
     out = np.empty(n_pad, dtype=np.int64)
     out[:len(rows)] = rank
     out[len(rows):] = np.arange(len(rows), n_pad)

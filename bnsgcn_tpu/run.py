@@ -41,13 +41,15 @@ from bnsgcn_tpu.data.artifacts import (PartitionArtifacts, build_artifacts,
 from bnsgcn_tpu.data.datasets import inductive_split, load_data
 from bnsgcn_tpu.data.graph import Graph
 from bnsgcn_tpu.data.partitioner import partition_graph
+from bnsgcn_tpu.data.reorder import maybe_reorder
 from bnsgcn_tpu.evaluate import evaluate_induc, evaluate_mesh, evaluate_trans
 from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
 from bnsgcn_tpu.parallel import coord as coord_mod
 from bnsgcn_tpu.parallel import feat as feat_mod
 from bnsgcn_tpu.parallel.mesh import replicated_sharding
 from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc
-from bnsgcn_tpu.trainer import (build_block_arrays, build_step_fns, init_training,
+from bnsgcn_tpu.trainer import (LAST_BUILD_TIMINGS, build_block_arrays,
+                                build_step_fns, init_training,
                                 local_part_ids, param_global_norm, place_blocks,
                                 place_blocks_local, place_replicated)
 from bnsgcn_tpu.utils import traceparse
@@ -251,6 +253,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         log("multi-host: artifacts carry no ELL geometry (old format); "
             "falling back to --spmm segment")
         cfg = cfg.replace(spmm="segment")
+    # ---- reorder pass (before the layout digest: the digest below hashes
+    # the POST-perm edge arrays, so permuted and raw layouts can never
+    # alias each other in the cache) ----
+    art, ro_resolved, _ro_info = maybe_reorder(cfg, art, log=log, obs=obs)
+    cfg = cfg.replace(reorder=ro_resolved)
 
     # ---- step functions + device data ----
     spec = spec_from_config(cfg)
@@ -262,7 +269,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     if cfg.cache_dir:
         import hashlib
 
-        from bnsgcn_tpu.trainer import ell_layout_key, hybrid_layout_key
+        from bnsgcn_tpu.trainer import (ell_layout_key, gat_layout_key,
+                                        hybrid_layout_key)
         from bnsgcn_tpu.utils.diskcache import (atomic_dump, sweep_stale_tmp,
                                                 try_load)
         os.makedirs(cfg.cache_dir, exist_ok=True)
@@ -289,7 +297,9 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         # preload both the fused and (under --overlap split) the ':ovl'
         # split-layout namespaces — build_step_fns may fall back to off,
         # and a downgraded run must still find its fused tables
-        keys = {"ell", "gat", hybrid_layout_key(cfg.replace(overlap="off"))}
+        keys = {ell_layout_key(cfg.replace(overlap="off")),
+                gat_layout_key(cfg),
+                hybrid_layout_key(cfg.replace(overlap="off"))}
         if cfg.overlap == "split":
             keys |= {ell_layout_key(cfg), hybrid_layout_key(cfg)}
         layout_cache, lc_loaded = {}, {}
@@ -300,6 +310,9 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 lc_loaded[key] = id(obj)
     fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh,
                                                      layout_cache=layout_cache)
+    if obs is not None:
+        for _st in LAST_BUILD_TIMINGS:
+            obs.emit("layout_build", **_st)
     if layout_cache is not None:
         for key, obj in layout_cache.items():
             # new or repaired-in-place entries (id changed) get persisted
@@ -349,6 +362,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         halo_label += "+go"
     elif use_refresh:
         halo_label += f"+hr{fns.halo_refresh}"
+    if cfg.reorder != "off":
+        halo_label += "+ro"
     # wire bytes are PER REPLICA per device (each replica row runs its own
     # parts-axis exchange) and reported exactly once — the replica axis adds
     # one fused gradient all-reduce per step, never more halo traffic. The
@@ -419,7 +434,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 "heads", "sampling_rate", "lr", "dtype", "spmm",
                 "use_pallas", "spmm_gather", "spmm_dense", "halo_exchange",
                 "halo_wire", "halo_refresh", "halo_mode", "overlap",
-                "n_epochs", "log_every", "seed",
+                "reorder", "n_epochs", "log_every", "seed",
                 "inductive", "use_pp", "resilience", "coord")})
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
@@ -465,6 +480,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                                    parts=local_part_ids(mesh))
         else:
             art_e = prepare_partition(cfg_e, graph)
+        # the training cfg already carries the RESOLVED reorder mode, so the
+        # eval subgraph gets the same treatment (its own perm — row ids are
+        # per-artifact) and gather_parts' global_nid indexing undoes it
+        art_e, _, _ = maybe_reorder(cfg_e.replace(reorder=cfg.reorder),
+                                    art_e, log=log)
         fns_e, _, _, tf = build_step_fns(cfg, spec, art_e, mesh)
         b = build_block_arrays(art_e, spec.model)
         b.update(fns_e.extra_blk)

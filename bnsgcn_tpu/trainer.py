@@ -23,6 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from functools import partial
 import sys
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -57,6 +58,18 @@ AUTO_HYBRID_MIN_COVERAGE = 0.5
 # configurations already warned about non-feat-shardable layers (the note
 # fires once per config, not once per build_step_fns call)
 _warned_unshardable: set = set()
+
+# per-stage layout-build timings of the MOST RECENT build_step_fns call:
+# [{'stage', 'ms', 'cached'}, ...]. Mutated in place (cleared on entry) so
+# run.py can read it right after the call and emit one `layout_build` obs
+# event per stage; purely informational, never branched on.
+LAST_BUILD_TIMINGS: list = []
+
+
+def _record_build(stage: str, t0: float, cached: bool):
+    LAST_BUILD_TIMINGS.append(
+        {"stage": stage, "ms": round((time.perf_counter() - t0) * 1e3, 1),
+         "cached": bool(cached)})
 
 
 # ----------------------------------------------------------------------------
@@ -238,31 +251,69 @@ def hybrid_tiling(cfg: Config) -> tuple[int, int, int]:
             cfg.block_tile, cfg.block_tile_budget_mb)
 
 
+def reorder_active(cfg: Config) -> bool:
+    """True when the artifacts this build sees are --reorder permuted (the
+    RESOLVED value: run.py/bench resolve 'auto' and apply the permutation
+    before building). Both the cluster perms and every layout-cache key
+    branch on this: reordered artifacts take IDENTITY perms (the artifact
+    order IS the cluster order — data/reorder.py packed it for tiles), and
+    keys gain a ':ro' namespace so a layout built from reordered rows can
+    never alias one built from the on-disk order."""
+    return getattr(cfg, "reorder", "off") not in (None, "", "off")
+
+
 def hybrid_layout_key(cfg: Config) -> str:
     """layout_cache key for the hybrid SpMM under cfg's tiling knobs —
     shared with bench.py's on-disk layout pickles so they cannot drift.
     Uses the EFFECTIVE occupancy, so auto (0) and an equal explicit value
     share one cache entry, and pre-tile-knob keys stay valid. --overlap
     split builds a differently-shaped (interior/frontier row-partitioned)
-    layout and gets its own ':ovl' namespace."""
+    layout and gets its own ':ovl' namespace; an applied --reorder builds
+    from permuted rows and gets ':ro'."""
     occ, tile, budget = hybrid_tiling(cfg)
     key = f"hybrid:{occ}:{budget}"
     if tile != 512:
         key += f":t{tile}"
     if cfg.overlap == "split":
         key += ":ovl"
+    if reorder_active(cfg):
+        key += ":ro"
     return key
 
 
 def ell_layout_key(cfg: Config) -> str:
     """layout_cache key for the pure-ELL SpMM ('ell', or 'ell:ovl' for the
-    --overlap split interior/frontier pair)."""
-    return "ell:ovl" if cfg.overlap == "split" else "ell"
+    --overlap split interior/frontier pair; ':ro' under an applied
+    --reorder — same degree multiset, different index tables)."""
+    key = "ell:ovl" if cfg.overlap == "split" else "ell"
+    if reorder_active(cfg):
+        key += ":ro"
+    return key
+
+
+def gat_layout_key(cfg: Config) -> str:
+    """layout_cache key for the GAT ELL-attention layout ('gat'; ':ro'
+    under an applied --reorder — geometry is order-invariant, the index
+    tables are not)."""
+    return "gat:ro" if reorder_active(cfg) else "gat"
+
+
+def _identity_perms(art: PartitionArtifacts):
+    pi = np.tile(np.arange(art.pad_inner, dtype=np.int64),
+                 (art.feat.shape[0], 1))
+    pe = np.tile(np.arange(art.n_ext, dtype=np.int64),
+                 (art.feat.shape[0], 1))
+    return pi, pe
 
 
 def _cluster_perms(art: PartitionArtifacts, cfg: Config):
     """Per-part cluster orders for the hybrid layout (shared by the fused
-    and --overlap split builds)."""
+    and --overlap split builds). Under an applied --reorder the rows
+    already sit in tile-packed cluster order, so the perms are identity
+    and the per-build LDG re-clustering pass (and its wall clock)
+    disappears."""
+    if reorder_active(cfg):
+        return _identity_perms(art)
     from bnsgcn_tpu.ops.block_spmm import cluster_order
     n_local = art.feat.shape[0]
     perms_i, perms_e = [], []
@@ -305,6 +356,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     — SpMM layout construction (minutes at bench scale) is memoized under
     the spmm kind, so e.g. bench's ell and ell+f8g candidates build once."""
     rate = cfg.sampling_rate if rate is None else rate
+    del LAST_BUILD_TIMINGS[:]           # this call's stage timings
     halo_strategy = cfg.halo_exchange
     if halo_strategy == "auto":
         # byte estimate + hop tiebreak over the GLOBAL n_b table, so every
@@ -417,12 +469,22 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         if spec.model in ("gcn", "graphsage"):
             from bnsgcn_tpu.ops.block_spmm import (cluster_order,
                                                    estimate_coverage)
+            t0_auto = time.perf_counter()
+            # an applied --reorder already packed rows for tiles: estimate
+            # coverage of the artifact order itself (identity perms) and
+            # skip the per-part LDG pass entirely
+            ro_active = reorder_active(cfg)
             n_local = art.feat.shape[0]
             perms_i, perms_e = [], []
             dense_e, total_e = 0.0, 0.0
             for p in range(n_local):
-                pi, pe = cluster_order(art.src[p], art.dst[p], art.pad_inner,
-                                       art.n_ext, target=cfg.block_tile)
+                if ro_active:
+                    pi = np.arange(art.pad_inner, dtype=np.int64)
+                    pe = np.arange(art.n_ext, dtype=np.int64)
+                else:
+                    pi, pe = cluster_order(art.src[p], art.dst[p],
+                                           art.pad_inner, art.n_ext,
+                                           target=cfg.block_tile)
                 perms_i.append(pi)
                 perms_e.append(pe)
                 real = art.dst[p] < art.pad_inner
@@ -445,6 +507,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                          else "ell")
             auto_perms = ((np.stack(perms_i), np.stack(perms_e))
                           if spmm_kind == "hybrid" else None)
+            _record_build("auto_coverage", t0_auto, cached=False)
             if jax.process_index() == 0:
                 print(f"spmm=auto: {frac:.1%} of edges densify onto MXU "
                       f"tiles -> {spmm_kind}", file=sys.stderr)
@@ -482,7 +545,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         from bnsgcn_tpu.ops.block_spmm import (build_split_block_layouts,
                                                make_block_spmm)
         hyb_key = hybrid_layout_key(key_cfg)            # 'hybrid:...:ovl'
-        if layout_cache is not None and hyb_key in layout_cache:
+        t0_b = time.perf_counter()
+        hyb_cached = layout_cache is not None and hyb_key in layout_cache
+        if hyb_cached:
             sb = layout_cache[hyb_key]
         else:
             perms_i, perms_e = (auto_perms if auto_perms is not None
@@ -494,6 +559,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                 tile_r=cfg.block_tile, tile_c=cfg.block_tile)
             if layout_cache is not None:
                 layout_cache[hyb_key] = sb
+        _record_build("hybrid_split", t0_b, hyb_cached)
         (int_f, int_b, int_pair), (fro_f, fro_b, fro_pair), s_arrays, _, _ = sb
         mk = partial(make_block_spmm, use_pallas=cfg.use_pallas)
         split_spmms = (mk(int_f, int_b, int_pair, gather_dtype=cfg.spmm_gather,
@@ -511,7 +577,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         from bnsgcn_tpu.ops.block_spmm import (build_block_layouts,
                                                make_block_spmm)
         hyb_key = hybrid_layout_key(key_cfg)
-        if layout_cache is not None and hyb_key in layout_cache:
+        t0_b = time.perf_counter()
+        hyb_cached = layout_cache is not None and hyb_key in layout_cache
+        if hyb_cached:
             fwd_b, bwd_b, ell_pair, ell_arrays = layout_cache[hyb_key]
             if cfg.spmm_dense == "int8":
                 # layouts cached before BlockSpec.max_row_dense existed
@@ -543,6 +611,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             if layout_cache is not None:
                 layout_cache[hyb_key] = (fwd_b, bwd_b, ell_pair,
                                          dict(ell_arrays))
+        _record_build("hybrid", t0_b, hyb_cached)
         ell_arrays = dict(ell_arrays)   # never alias the cache (extra_blk is
         ell_spmm = make_block_spmm(fwd_b, bwd_b, ell_pair,  # caller-mutable)
                                    use_pallas=cfg.use_pallas,
@@ -561,13 +630,16 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
           and overlap == "split"):
         from bnsgcn_tpu.ops.ell import build_split_layouts, make_ell_spmm
         skey = ell_layout_key(key_cfg)                  # 'ell:ovl'
-        if layout_cache is not None and skey in layout_cache:
+        t0_b = time.perf_counter()
+        ell_cached = layout_cache is not None and skey in layout_cache
+        if ell_cached:
             sb = layout_cache[skey]
         else:
             sb = build_split_layouts(art.src, art.dst, art.pad_inner,
                                      art.n_ext)
             if layout_cache is not None:
                 layout_cache[skey] = sb
+        _record_build("ell_split", t0_b, ell_cached)
         (int_f, int_b), (fro_f, fro_b), s_arrays, _, _ = sb
 
         def mke(f, b, **kw):
@@ -585,14 +657,18 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         split_kind = "ell"
     elif spmm_kind == "ell" and spec.model in ("gcn", "graphsage"):
         from bnsgcn_tpu.ops.ell import build_layouts, make_ell_spmm
-        if layout_cache is not None and "ell" in layout_cache:
-            fwd_spec, bwd_spec, ell_arrays = layout_cache["ell"]
+        ekey = ell_layout_key(key_cfg)                  # 'ell' / 'ell:ro'
+        t0_b = time.perf_counter()
+        ell_cached = layout_cache is not None and ekey in layout_cache
+        if ell_cached:
+            fwd_spec, bwd_spec, ell_arrays = layout_cache[ekey]
         else:
             fwd_spec, bwd_spec, ell_arrays = build_layouts(
                 art.src, art.dst, art.pad_inner, art.n_ext,
                 geometry=art.ell_geometry)
             if layout_cache is not None:
-                layout_cache["ell"] = (fwd_spec, bwd_spec, dict(ell_arrays))
+                layout_cache[ekey] = (fwd_spec, bwd_spec, dict(ell_arrays))
+        _record_build("ell", t0_b, ell_cached)
         ell_arrays = dict(ell_arrays)   # never alias the cache
         ell_spmm = make_ell_spmm(fwd_spec, bwd_spec,
                                  len(fwd_spec.widths), len(bwd_spec.widths),
@@ -608,7 +684,9 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
         # 'segment' COO path: the row split is just two edge lists (no
         # layout build); recombination is an exact add of disjoint rows
         from bnsgcn_tpu.ops.spmm import split_coo
+        t0_b = time.perf_counter()
         ell_arrays = dict(split_coo(art.src, art.dst, art.pad_inner))
+        _record_build("segment_split", t0_b, cached=False)
         split_kind = "segment"
 
     # dense per-row GAT attention over an (uncapped) ELL layout; geometry
@@ -617,8 +695,11 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     if spmm_kind in ("ell", "hybrid") and spec.model == "gat":
         geo = (art.ell_geometry or {}).get("gat_fwd")
         if geo is not None or art.feat.shape[0] == art.n_parts:
-            if layout_cache is not None and "gat" in layout_cache:
-                gat_spec, gat_arrays = layout_cache["gat"]
+            gkey = gat_layout_key(cfg)                  # 'gat' / 'gat:ro'
+            t0_b = time.perf_counter()
+            gat_cached = layout_cache is not None and gkey in layout_cache
+            if gat_cached:
+                gat_spec, gat_arrays = layout_cache[gkey]
             else:
                 from bnsgcn_tpu.ops.ell_attention import build_gat_layouts
                 gat_spec, gat_arrays = build_gat_layouts(
@@ -628,7 +709,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                     # minutes of host numpy at bench scale — cacheable like
                     # the ell/hybrid layouts (geometry depends only on the
                     # artifacts, not on heads/hidden/dtype)
-                    layout_cache["gat"] = (gat_spec, dict(gat_arrays))
+                    layout_cache[gkey] = (gat_spec, dict(gat_arrays))
+            _record_build("gat", t0_b, gat_cached)
             ell_arrays.update(gat_arrays)
             gat_keys = tuple(gat_arrays.keys())
 

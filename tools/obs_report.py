@@ -47,7 +47,8 @@ from bnsgcn_tpu.obs import EVENT_KINDS, load_events  # noqa: E402
 
 LIFECYCLE_KINDS = ("inject", "rollback", "preempt", "watchdog_fire",
                    "divergence_abort", "coord_decision", "profile_request",
-                   "profile", "halo_refresh", "strict_exec")
+                   "profile", "halo_refresh", "strict_exec",
+                   "reorder", "layout_build")
 
 # the report's sub-vocabularies must stay inside the bus registry —
 # graftlint checks the emit sites, this checks the reader
@@ -164,6 +165,23 @@ def render(s: dict, write=print):
         if part:
             write("partition: " + " ".join(f"{k}={v}"
                                            for k, v in sorted(part.items())))
+    # reorder + layout-build get dedicated lines (and are dropped from the
+    # generic lifecycle dump below — one record each, better as a summary)
+    ro = next((ev for ev in s["lifecycle"] if ev["kind"] == "reorder"), None)
+    if ro is not None:
+        write(f"reorder: {ro.get('mode')} -> {ro.get('resolved')} "
+              f"[{ro.get('algorithm')} t{ro.get('tile')}] tile coverage "
+              f"{100 * _num(ro.get('coverage_before')):.1f}% -> "
+              f"{100 * _num(ro.get('coverage_after')):.1f}% "
+              f"({ro.get('build_ms')} ms"
+              + (", order cached" if ro.get("cached") else "") + ")")
+    lb = [ev for ev in s["lifecycle"] if ev["kind"] == "layout_build"]
+    if lb:
+        stages = " + ".join(
+            f"{ev.get('stage')} {ev.get('ms')} ms"
+            + (" (cached)" if ev.get("cached") else "") for ev in lb)
+        write(f"layout build: {stages} | total "
+              f"{sum(_num(ev.get('ms')) for ev in lb):.1f} ms")
     epochs = s["epochs"]
     if epochs:
         ranks = sorted({r for by_r in epochs.values() for r in by_r})
@@ -249,10 +267,12 @@ def render(s: dict, write=print):
             except Exception:
                 pass
         write(line)
-    if s["lifecycle"]:
+    life = [ev for ev in s["lifecycle"]
+            if ev["kind"] not in ("reorder", "layout_build")]
+    if life:
         write("")
         write("lifecycle:")
-        for ev in s["lifecycle"]:
+        for ev in life:
             extra = {k: v for k, v in ev.items()
                      if k not in ("ts", "kind", "rank")}
             write(f"  r{ev.get('rank', 0)} {ev['kind']}: "
@@ -325,7 +345,8 @@ def compare(sa: dict, sb: dict, name_a: str, name_b: str, write=print):
               f"halo={hdr.get('halo', '?')}/{hdr.get('wire', '?')} mesh="
               f"{hdr.get('mesh', '?')} wire_mb={hdr.get('wire_mb_per_exchange')}"
               f" halo_refresh={hdr.get('halo_refresh', 1)}"
-              f" steady_mb={hdr.get('wire_mb_steady')}")
+              f" steady_mb={hdr.get('wire_mb_steady')}"
+              f" reorder={cfg.get('reorder', 'off')}")
     ka = ((sa["header"] or {}).get("halo_refresh", 1),
           (sa["header"] or {}).get("halo_mode", "exchange"))
     kb = ((sb["header"] or {}).get("halo_refresh", 1),
@@ -336,6 +357,14 @@ def compare(sa: dict, sb: dict, name_a: str, name_b: str, write=print):
         write(f"  NOTE: halo refresh differs (A K={ka[0]} mode={ka[1]} vs "
               f"B K={kb[0]} mode={kb[1]}) — comm volume and staleness are "
               f"part of the trajectory delta")
+    ra = ((sa["header"] or {}).get("config", {}) or {}).get("reorder", "off")
+    rb = ((sb["header"] or {}).get("config", {}) or {}).get("reorder", "off")
+    if ra != rb:
+        # row order changes sum-reduction pairing: losses ULP-drift apart
+        # even when the math is the same aggregation
+        write(f"  NOTE: reorder differs (A {ra} vs B {rb}) — step-time "
+              f"deltas include the tile-coverage effect, and loss deltas "
+              f"at round-off scale are expected from the row permutation")
     if sa["bench"] or sb["bench"]:
         by = {}
         for tag, s in (("a", sa), ("b", sb)):

@@ -1,32 +1,112 @@
-"""Dense-tile coverage + layout build cost on the full-scale dcsbm bench graph."""
-import os, sys, time
+"""Dense-tile coverage + layout build cost audit.
+
+Default: one ordering (the pre-reorder cluster_order baseline) on the
+full-scale bench graph, as before. `--reorder` runs the A/B/C audit the
+reorder pass is judged by — identity order, cluster_order (pre-PR
+baseline), and the data/reorder LPA+FFD permutation — printing tile
+coverage, occupied-tile count, and residual-ELL padded-slot count for
+each, plus per-stage build timings. Coverage gains are auditable here
+without a bench run.
+
+  python tools/tiling_check.py --graph uniform --reorder
+  python tools/tiling_check.py --graph dcsbm-mid --tile 256 --reorder
+"""
+import argparse
+import os
+import sys
+import time
+
 import numpy as np
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.getcwd())
-from bench import _cached_graph
-from bnsgcn_tpu.data.artifacts import build_artifacts
-from bnsgcn_tpu.data.partitioner import partition_graph
-from bnsgcn_tpu.ops.block_spmm import (TC, TR, build_block_layouts,
-                                       cluster_order, dense_edge_count)
 
-log = lambda *a: print(*a, flush=True)
-g = _cached_graph(116482, 492, "./bench_cache", log, kind="dcsbm")
-t0 = time.time()
-art = build_artifacts(g, partition_graph(g, 1))
-log(f"artifacts {time.time()-t0:.0f}s")
-t0 = time.time()
-pi, pe = cluster_order(art.src[0], art.dst[0], art.pad_inner, art.n_ext)
-log(f"cluster_order {time.time()-t0:.0f}s")
-t0 = time.time()
-fwd, bwd, ell_pair, arrays = build_block_layouts(
-    art.src, art.dst, art.pad_inner, art.n_ext, pi[None], pe[None])
-dc = dense_edge_count(arrays)
-# a graph whose occupancy filter keeps no dense tiles omits the key
-bt = arrays.get("blk_tiles_fwd")
-B = bt.shape[1] if bt is not None else 0
-log(f"tiling {time.time()-t0:.0f}s: {dc/1e6:.1f}M / {g.n_edges/1e6:.1f}M edges dense "
-    f"({dc/g.n_edges:.1%}), {B} tiles ({B*TR*TC/1e9:.2f} GB int8), "
-    f"avg occupancy {dc/max(B,1)/(TR*TC):.1%}")
-res_rows = sum(arrays[f"res_fwd_idx_{k}"].shape[1] * w
-               for k, w in enumerate(ell_pair[0].widths))
-log(f"residual ELL padded gathers ~{res_rows/1e6:.1f}M")
+log = lambda *a: print(*a, flush=True)  # noqa: E731
+
+
+def _residual_slots(ell_pair):
+    spec = ell_pair[0]
+    return sum(r * w for r, w in zip(spec.rows, spec.widths))
+
+
+def _audit(name, art, pi, pe, tile, occ, n_real):
+    from bnsgcn_tpu.ops.block_spmm import (build_block_layouts,
+                                           dense_edge_count)
+    t0 = time.time()
+    fwd, bwd, ell_pair, arrays = build_block_layouts(
+        art.src, art.dst, art.pad_inner, art.n_ext, pi, pe,
+        occupancy_min=occ, tile_r=tile, tile_c=tile)
+    dt = time.time() - t0
+    P = art.src.shape[0]
+    dc = sum(dense_edge_count(arrays, part=p) for p in range(P))
+    bt = arrays.get("blk_tiles_fwd")
+    B = bt.shape[0] * bt.shape[1] if bt is not None else 0
+    resid = _residual_slots(ell_pair) * P
+    log(f"{name:<10} coverage {dc / max(n_real, 1):6.1%}  "
+        f"occupied tiles {B:5d} ({B * tile * tile / 1e9:.2f} GB int8)  "
+        f"residual slots {resid / 1e6:6.2f}M  build {dt:6.1f}s")
+    return dc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--graph", default="dcsbm",
+                    choices=["uniform", "dcsbm", "dcsbm-mid"])
+    ap.add_argument("--nodes", type=int, default=116482)
+    ap.add_argument("--degree", type=int, default=492)
+    ap.add_argument("--parts", type=int, default=1)
+    ap.add_argument("--tile", type=int, default=512, choices=[512, 256])
+    ap.add_argument("--cache-dir", default="./bench_cache")
+    ap.add_argument("--reorder", action="store_true",
+                    help="A/B audit: identity vs cluster_order vs the "
+                         "data/reorder permutation")
+    args = ap.parse_args()
+
+    from bench import _cached_graph
+    from bnsgcn_tpu.data.artifacts import build_artifacts
+    from bnsgcn_tpu.data.partitioner import partition_graph
+    from bnsgcn_tpu.data.reorder import (REORDER_ALGO, apply_reorder,
+                                         compute_orders)
+    from bnsgcn_tpu.ops.block_spmm import cluster_order, effective_occupancy
+
+    g = _cached_graph(args.nodes, args.degree, args.cache_dir, log,
+                      kind=args.graph)
+    t0 = time.time()
+    art = build_artifacts(g, partition_graph(g, args.parts))
+    log(f"artifacts {time.time() - t0:.0f}s "
+        f"({args.parts} part(s), pad_inner {art.pad_inner})")
+    P = art.src.shape[0]
+    occ = effective_occupancy(0, args.tile, args.tile)
+    n_real = int((art.dst < art.pad_inner).sum())
+    ident_i = np.tile(np.arange(art.pad_inner), (P, 1))
+    ident_e = np.tile(np.arange(art.n_ext), (P, 1))
+    log(f"tile {args.tile} occupancy_min {occ}: {n_real / 1e6:.1f}M edges")
+
+    t0 = time.time()
+    pi = np.stack([cluster_order(art.src[p], art.dst[p], art.pad_inner,
+                                 art.n_ext)[0] for p in range(P)])
+    pe = np.concatenate(
+        [pi, np.tile(np.arange(art.pad_inner, art.n_ext), (P, 1))], axis=1)
+    t_cluster = time.time() - t0
+
+    if not args.reorder:
+        log(f"cluster_order {t_cluster:.0f}s")
+        _audit("cluster", art, pi, pe, args.tile, occ, n_real)
+        return
+
+    t0 = time.time()
+    orders = compute_orders(art, tile_r=args.tile)
+    art_ro = apply_reorder(art, orders)
+    t_ro = time.time() - t0
+    log(f"order build: cluster_order {t_cluster:.1f}s, "
+        f"{REORDER_ALGO} reorder {t_ro:.1f}s")
+    _audit("identity", art, ident_i, ident_e, args.tile, occ, n_real)
+    _audit("cluster", art, pi, pe, args.tile, occ, n_real)
+    # the reorder pass bakes its permutation into the artifact itself, so
+    # its layout build runs with identity perms — exactly what a
+    # --reorder cluster training run does
+    _audit("reorder", art_ro, ident_i, ident_e, args.tile, occ, n_real)
+
+
+if __name__ == "__main__":
+    main()
