@@ -257,12 +257,22 @@ def _vro(v):
     return v[10] if len(v) > 10 else "off"
 
 
+def _vat(v):
+    """Auto-tune flag of a variant tuple (12th field: 'sched' runs the
+    fixed coarse->fine staleness anneal of tune.bench_schedule — K=4 from
+    epoch 0, K=2 at 40%, K=1 at 70% — with each retune's rebuild + compile
+    epochs excluded from the mean, the bench twin of run.py's `--tune`);
+    shorter tuples mean 'off' — pre-existing names and queue lines stay
+    valid."""
+    return v[11] if len(v) > 11 else "off"
+
+
 def _vname(v):
     """Candidate display/CLI name for a (spmm, use_pallas, gather_dtype,
     dense_dtype, tile[, halo[, overlap[, replicas[, feat[, refresh[,
-    reorder]]]]]]) variant tuple — the vocabulary --candidates and
-    .watch_queue lines are written in (unit-pinned so a rename can never
-    silently invalidate a queued tunnel-window run)."""
+    reorder[, autotune]]]]]]]) variant tuple — the vocabulary --candidates
+    and .watch_queue lines are written in (unit-pinned so a rename can
+    never silently invalidate a queued tunnel-window run)."""
     return (v[0] + ("+pallas" if v[1] else "")
             + ({"fp8": "+f8g", "int8": "+i8g"}.get(v[2], ""))
             + ("+i8d" if v[3] == "int8" else "")
@@ -272,7 +282,8 @@ def _vname(v):
             + (f"+rep{_vrep(v)}" if _vrep(v) != 1 else "")
             + (f"+feat{_vfeat(v)}" if _vfeat(v) != 1 else "")
             + (f"+hr{_vhr(v)}" if _vhr(v) != 1 else "")
-            + ("+ro" if _vro(v) != "off" else ""))
+            + ("+ro" if _vro(v) != "off" else "")
+            + ("+at" if _vat(v) != "off" else ""))
 
 
 def _emit_result_line(args, value, status=None, measured_at=None, spmm=None,
@@ -563,7 +574,10 @@ def main():
                          "into the artifact before layouts build — higher "
                          "dense-tile coverage on low-locality graphs: "
                          "hybrid+ro, hybrid+t256+ro, hybrid+pallas+ro, "
-                         "hybrid+pallas+t256+ro)"
+                         "hybrid+pallas+t256+ro; a +at suffix runs the "
+                         "closed-loop staleness anneal (tune.bench_schedule"
+                         ": K=4 from epoch 0, K=2 at 40%, K=1 at 70%, "
+                         "retune rebuilds untimed): hybrid+pallas+at)"
                          " — for short TPU-tunnel windows. The pallas names "
                          "only exist on a TPU backend without --no-pallas; "
                          "an all-unknown list is an error (exit 2), never a "
@@ -741,7 +755,14 @@ def main():
                      ("hybrid", True, "native", "native", 512, "padded",
                       "off", 1, 1, 1, "cluster"),
                      ("hybrid", True, "native", "native", 256, "padded",
-                      "off", 1, 1, 1, "cluster")]
+                      "off", 1, 1, 1, "cluster"),
+                     # closed-loop staleness anneal (--tune / tune.py): the
+                     # fixed coarse->fine schedule K=4 -> 2 -> 1 with each
+                     # retune's rebuild+compile epochs untimed — measures
+                     # what a tuned run's STEADY epochs cost vs the static
+                     # +hrK points on either side of the anneal
+                     ("hybrid", True, "native", "native", 512, "padded",
+                      "off", 1, 1, 1, "off", "sched")]
     universe += [("hybrid", False, "native", "native", 512),
                  ("hybrid", False, "native", "native", 256),
                  ("hybrid", False, "native", "int8", 512),
@@ -834,7 +855,7 @@ def main():
 
     def make_cfg(variant):
         spmm, use_pallas, gather, dense, tile = variant[:5]
-        return Config(model=args.model,
+        cfg = Config(model=args.model,
                       halo_exchange=_vhalo(variant),
                       overlap=_vovl(variant),
                       replicas=_vrep(variant),
@@ -852,6 +873,15 @@ def main():
                       block_tile=tile,
                       n_feat=art.n_feat, n_class=art.n_class,
                       n_train=art.n_train)
+        if _vat(variant) != "off":
+            # +at starts at the anneal's epoch-0 point (K=4) so the first
+            # compile already targets the coarse geometry — never a
+            # throwaway build, exactly run.py's startup fold
+            from bnsgcn_tpu import tune as tune_mod
+            for _ep, _ch in tune_mod.bench_schedule(args.epochs):
+                if _ep == 0:
+                    cfg = cfg.replace(**_ch)
+        return cfg
 
     # +ro candidates run on the PERMUTED artifact (what run.py's
     # maybe_reorder produces) — the perm depends on the tile size, so
@@ -935,32 +965,57 @@ def main():
             f"loss={float(loss):.4f}")
         from bnsgcn_tpu.utils.timers import estimate_static_hbm
         hbm = estimate_static_hbm([blk], [params, opt, state])
-        return (fns, blk, tables_d, params, state, opt, loss, cache,
-                tables_r_d, hbm)
+        ctx = {"cfg": cfg}
 
-    def measure(built, name="run"):
+        def rebuild(changes):
+            """+at retune: rebuild the step fns under changed comm levers.
+            The shared layout cache absorbs the SpMM layout work (its keys
+            do not depend on any tuned lever), so a retune costs one
+            build + one compile — the same contract as run.py's --tune."""
+            ctx["cfg"] = ctx["cfg"].replace(**changes)
+            f2, _h2, tb2, tbf2 = build_step_fns(
+                ctx["cfg"], spec, v_art, mesh, layout_cache=layout_cache)
+            tr2 = (place_replicated(f2.tables_refresh, mesh)
+                   if f2.tables_refresh is not None else None)
+            return f2, place_replicated(tb2, mesh), tr2
+
+        return (fns, blk, tables_d, params, state, opt, loss, cache,
+                tables_r_d, rebuild, hbm)
+
+    def measure(built, name="run", at_sched=None):
         """Timed epochs; chains CHUNK epochs between host syncs so the
         ~50-80ms tunnel round-trip amortizes out (matches the reference's
         free-running epoch loop). Under --profile-dir the FIRST chunk is
         traced (device-lane op breakdown); its timing includes profiler
-        overhead, which is why traced runs never update best_known."""
+        overhead, which is why traced runs never update best_known.
+        `at_sched` ({epoch: lever changes}, +at candidates only) retunes
+        the comm stack mid-run: the rebuild and its compile epochs are
+        REAL training steps (the loss trajectory continues through them)
+        but run untimed, the same compile-exclusion every other candidate
+        gets for its first step."""
         (fns, blk, tables_d, params, state, opt, loss, cache,
-         tables_r, _) = built
+         tables_r, rebuild, _) = built
         use_refresh = cache is not None
+        at_sched = dict(at_sched or {})
         CHUNK = 4
         total_t, min_t = 0.0, float("inf")
+        timed_n = 0
         e = 1
-        if use_refresh:
+
+        def _untimed_cached():
             # the steady-state (cached) step compiles on ITS first call —
-            # run it once untimed so +hrK candidates get the same
+            # run it once untimed so +hrK/+at candidates get the same
             # compile-excluded treatment as everyone else (whose only
             # compile happened in setup_and_compile)
+            nonlocal params, state, opt, loss, cache, e
             params, state, opt, loss, cache = fns.train_step_cached(
                 params, state, opt, jnp.uint32(e), blk, tables_r, cache,
                 skey, dkey)
             _ = float(loss)
             e += 1
-        n_timed = max(args.epochs - e + 1, 1)
+
+        if use_refresh:
+            _untimed_cached()
         tracing = False
         if args.profile_dir:
             jax.profiler.start_trace(os.path.join(
@@ -968,7 +1023,38 @@ def main():
             tracing = True
         try:
             while e <= args.epochs:
+                due = sorted(ep for ep in at_sched if ep <= e)
+                if due:
+                    # +at retune boundary: fold every due entry, rebuild,
+                    # and pay the full-refresh + compile epochs untimed
+                    changes = {}
+                    for ep in due:
+                        changes.update(at_sched.pop(ep))
+                    log("  at: epoch %d retune -> %s" % (e, " ".join(
+                        f"{k}={v}" for k, v in sorted(changes.items()))))
+                    fns, tables_d, tables_r = rebuild(changes)
+                    use_refresh = fns.train_step_full is not None
+                    if use_refresh:
+                        params, state, opt, loss, cache = fns.train_step_full(
+                            params, state, opt, jnp.uint32(e), blk, tables_d,
+                            skey, dkey)
+                        _ = float(loss)
+                        e += 1
+                        if e <= args.epochs:
+                            _untimed_cached()
+                    else:
+                        cache = None
+                        params, state, opt, loss = fns.train_step(
+                            params, state, opt, jnp.uint32(e), blk, tables_d,
+                            skey, dkey)
+                        _ = float(loss)
+                        e += 1
+                    continue
                 n = min(CHUNK, args.epochs - e + 1)
+                nxt = min((ep for ep in at_sched), default=None)
+                if nxt is not None and nxt > e:
+                    # never time across a retune boundary
+                    n = min(n, nxt - e)
                 t0 = time.perf_counter()
                 for _ in range(n):
                     if use_refresh:
@@ -989,13 +1075,30 @@ def main():
                     jax.profiler.stop_trace()
                     tracing = False
                 total_t += dt
+                timed_n += n
                 min_t = min(min_t, dt / n)
         finally:
             if tracing:           # exception mid-measure: never leak the
                 jax.profiler.stop_trace()   # trace into the next candidate
+        if timed_n == 0:
+            # a tiny-epoch +at run (e.g. the preflight's --epochs 2
+            # override) can spend EVERY epoch on retune/compile
+            # boundaries; time one extra epoch so the result line always
+            # carries a real measurement instead of dividing by zero
+            t0 = time.perf_counter()
+            if use_refresh:
+                params, state, opt, loss, cache = fns.train_step_cached(
+                    params, state, opt, jnp.uint32(e), blk, tables_r,
+                    cache, skey, dkey)
+            else:
+                params, state, opt, loss = fns.train_step(
+                    params, state, opt, jnp.uint32(e), blk, tables_d,
+                    skey, dkey)
+            _ = float(loss)
+            total_t, timed_n = time.perf_counter() - t0, 1
         if min_t == float("inf"):     # --epochs 1 +hrK: warmup ate the run
-            min_t = total_t / n_timed
-        return total_t / n_timed, min_t, loss
+            min_t = total_t / max(timed_n, 1)
+        return total_t / max(timed_n, 1), min_t, loss
 
     best, ref_loss, ref_final = None, None, None
     # step-0 / final losses of the NATIVE (unquantized) run of each SpMM
@@ -1133,6 +1236,10 @@ def main():
             # widened gate and never becomes the native twin its raw-order
             # siblings gate against
             ro = _vro(variant) != "off"
+            # +at anneals K mid-run: its trajectory carries the staleness
+            # drift of every rung it visits, so it rides the widened gate
+            # like +hrK and never becomes a native twin
+            at = _vat(variant) != "off"
             base = variant[0] + ("+pallas" if variant[1] else "")
             # quantized variants gate against their NATIVE TWIN (same SpMM
             # base, native gathers/tiles) at 5%: the twin isolates exactly
@@ -1145,7 +1252,7 @@ def main():
             # (+featT only reorders float sums, but shares the exclusion).
             if quantized and base in native_l0:
                 gate0, tol0, gsrc = native_l0[base], 0.05, f"native {base}"
-            elif quantized or multi_dev or stale or ro:
+            elif quantized or multi_dev or stale or ro or at:
                 gate0, tol0, gsrc = ref_loss, 0.07, "ell anchor"
             else:
                 gate0, tol0, gsrc = ref_loss, 0.02, "ell anchor"
@@ -1154,7 +1261,12 @@ def main():
                 log(f"  spmm={name} step-0 loss {l0:.4f} != {gsrc} "
                     f"{gate0:.4f} (tol {tol0:.0%}); DISCARDED")
                 continue
-            et, mt, loss = measure(built, name)
+            at_sched = None
+            if at:
+                from bnsgcn_tpu import tune as tune_mod
+                at_sched = {ep: ch for ep, ch in
+                            tune_mod.bench_schedule(args.epochs) if ep > 0}
+            et, mt, loss = measure(built, name, at_sched)
         except Exception as ex:       # pragma: no cover - fallback path
             log(f"  spmm={name} failed ({type(ex).__name__}: {ex}); "
                 f"falling back")
@@ -1169,7 +1281,7 @@ def main():
         # diverges the trajectory); same twin-first gating as step 0
         if quantized and base in native_lf:
             gate_f, tol, gsrc = native_lf[base], 0.05, f"native {base}"
-        elif quantized or multi_dev or stale or ro:
+        elif quantized or multi_dev or stale or ro or at:
             gate_f, tol, gsrc = ref_final, 0.07, "ell anchor"
         else:
             gate_f, tol, gsrc = ref_final, 0.02, "ell anchor"
@@ -1177,7 +1289,8 @@ def main():
             log(f"  spmm={name} final loss {lf:.4f} != {gsrc} "
                 f"{gate_f:.4f} (tol {tol:.0%}); DISCARDED")
             continue
-        if not quantized and not multi_dev and not stale and not ro:
+        if not quantized and not multi_dev and not stale and not ro \
+                and not at:
             # record the twin reference only for a native run that passed
             # BOTH gates — a diverged native run must never become the
             # gate its quantized twins are judged against

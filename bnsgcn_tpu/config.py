@@ -166,6 +166,21 @@ class Config:
                                         # presence-masked out of GAT softmax;
                                         # the per-step gradient all-reduce is the
                                         # only collective left)
+    tune: str = "off"                   # closed-loop comm auto-tuner (tune.py):
+                                        # 'off' (launch levers frozen, bit-
+                                        # identical pre-tune loop) | 'schedule'
+                                        # (declarative per-epoch lever schedule,
+                                        # --tune-schedule) | 'auto' (feedback
+                                        # anneal on the obs bus: staleness
+                                        # tightens as loss flattens, strategy/
+                                        # codec re-picked from MEASURED comm
+                                        # share; single-process only). Every
+                                        # move is a tune_decision event and a
+                                        # full-refresh rebuild of the step fns
+    tune_schedule: str = ""             # --tune schedule grammar: comma-
+                                        # separated lever=value@epoch, levers
+                                        # K/mode/strategy/wire (e.g.
+                                        # 'K=4@0,K=2@30,K=1@60,wire=bf16@30')
     overlap: str = "off"                # 'off' (fused exchange-then-aggregate; the
                                         # historical step graph) | 'split' (interior/
                                         # frontier row-split aggregation: the halo
@@ -368,6 +383,17 @@ def create_parser() -> argparse.ArgumentParser:
          help="'grad-only' skips the activation exchange entirely "
               "(local-only aggregation; the per-step gradient all-reduce is "
               "the only collective left)")
+    p.add_argument("--tune", type=str, default="off",
+                   choices=["off", "schedule", "auto"],
+                   help="closed-loop comm auto-tuner (tune.py): retune "
+                        "staleness/strategy/codec at epoch boundaries from "
+                        "the obs-bus metrics ('auto', single-process) or a "
+                        "declarative --tune-schedule ('schedule'); every "
+                        "move is an audited tune_decision event")
+    both("tune-schedule", type=str, default="",
+         help="--tune schedule grammar: comma-separated lever=value@epoch "
+              "with levers K/mode/strategy/wire, e.g. "
+              "'K=4@0,K=2@30,K=1@60,wire=bf16@30'")
     p.add_argument("--overlap", type=str, default="off", choices=["off", "split"])
     both("streaming-artifacts", type=str, default="auto",
          choices=["auto", "always", "never"])

@@ -58,7 +58,7 @@ __all__ = [
 EVENT_KINDS = (
     # training lifecycle (run.py)
     "run_header", "epoch", "epoch_ranks", "eval", "trace", "overlap",
-    "halo_refresh", "reorder", "layout_build", "run_end",
+    "halo_refresh", "reorder", "layout_build", "tune_decision", "run_end",
     # resilience (resilience.py: injections, rollback consensus, exits)
     "inject", "rollback", "divergence_abort", "coord_decision",
     "watchdog_fire", "preempt", "profile_request", "profile",

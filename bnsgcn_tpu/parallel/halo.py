@@ -235,6 +235,26 @@ def select_halo_strategy(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
         f"rows saves <{SHIFT_MIN_SAVING:.0%} (not worth P-1 serialized hops)")
 
 
+def retune_strategy(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
+                    rate: float, current: str, wire: str = "native",
+                    allow_ragged: Optional[bool] = None) -> Optional[tuple]:
+    """The `--tune` controller's strategy re-pick: the same wire-bytes
+    estimate `--halo-exchange auto` runs at launch, re-framed as "is there a
+    better strategy than the one this run is EXECUTING". Returns
+    ``(strategy, why)`` when the estimate prefers a different strategy, else
+    None. The caller (tune.decide) only acts on it when the MEASURED epoch
+    comm share is high — the estimate proposes, the measurement disposes,
+    which is the difference from the launch-time pick that has nothing but
+    the estimate to go on."""
+    if allow_ragged is None:
+        allow_ragged = ragged_auto_eligible()
+    best, why = select_halo_strategy(n_b, pad_inner, pad_boundary, rate,
+                                     wire=wire, allow_ragged=allow_ragged)
+    if best == current:
+        return None
+    return best, why
+
+
 @dataclass
 class HaloPlan:
     """Per-epoch sampling decisions, shared by every layer's exchange

@@ -35,6 +35,7 @@ from bnsgcn_tpu import checkpoint as ckpt
 from bnsgcn_tpu import obs as obs_mod
 from bnsgcn_tpu import resilience
 from bnsgcn_tpu import strict as strict_mod
+from bnsgcn_tpu import tune as tune_mod
 from bnsgcn_tpu.config import Config, ConfigError
 from bnsgcn_tpu.data.artifacts import (PartitionArtifacts, build_artifacts,
                                        load_artifacts, save_artifacts)
@@ -259,6 +260,20 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     art, ro_resolved, _ro_info = maybe_reorder(cfg, art, log=log, obs=obs)
     cfg = cfg.replace(reorder=ro_resolved)
 
+    # ---- closed-loop comm auto-tuner (--tune, tune.py): fold the launch
+    # point of the schedule/anneal into cfg BEFORE the first build so a
+    # coarse start (K=4, grad-only) never pays a throwaway compile ----
+    _tune_start = None
+    tune_mod.validate_mode(cfg, multi_host=multi_host,
+                           coordinated=coordinator is not None)
+    if cfg.tune != "off":
+        _ch0, _why0 = tune_mod.startup_changes(cfg)
+        if _ch0:
+            cfg = cfg.replace(**_ch0)
+            _tune_start = (_ch0, _why0)
+            log(f"[tune] {_why0}: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(_ch0.items())))
+
     # ---- step functions + device data ----
     spec = spec_from_config(cfg)
     # --cache-dir / $BNSGCN_CACHE_DIR: persist SpMM layout builds (~980 s at
@@ -308,12 +323,18 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             if obj is not None:
                 layout_cache[key] = obj
                 lc_loaded[key] = id(obj)
+    elif cfg.tune != "off":
+        # no disk cache, but the --tune controller may rebuild the step fns
+        # mid-run: an in-memory layout cache makes those rebuilds hit the
+        # already-built SpMM layouts (the layout keys do not depend on any
+        # tuned lever), so a retune never pays the layout build twice
+        layout_cache, lc_loaded = {}, {}
     fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh,
                                                      layout_cache=layout_cache)
     if obs is not None:
         for _st in LAST_BUILD_TIMINGS:
             obs.emit("layout_build", **_st)
-    if layout_cache is not None:
+    if cfg.cache_dir and layout_cache is not None:
         for key, obj in layout_cache.items():
             # new or repaired-in-place entries (id changed) get persisted
             if lc_loaded.get(key) != id(obj):
@@ -434,8 +455,32 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 "heads", "sampling_rate", "lr", "dtype", "spmm",
                 "use_pallas", "spmm_gather", "spmm_dense", "halo_exchange",
                 "halo_wire", "halo_refresh", "halo_mode", "overlap",
-                "reorder", "n_epochs", "log_every", "seed",
+                "reorder", "tune", "tune_schedule",
+                "n_epochs", "log_every", "seed",
                 "inductive", "use_pp", "resilience", "coord")})
+
+    # ---- --tune controller, bound to the RESOLVED levers (post
+    # startup fold, post `--halo-exchange auto` pick): the base every
+    # later rewind/restore diffs against ----
+    tuner = None
+    if cfg.tune != "off":
+        tuner = tune_mod.Tuner(cfg, levers={
+            "halo_refresh": int(fns.halo_refresh),
+            "halo_mode": fns.halo_mode,
+            "halo_exchange": fns.halo_strategy,
+            "halo_wire": hspec.wire,
+        }, log=log)
+        if _tune_start is not None:
+            _ent0 = tuner.record_startup(*_tune_start)
+            if obs is not None:
+                obs.emit("tune_decision", **_ent0)
+        if cfg.tune == "auto":
+            from bnsgcn_tpu.parallel.halo import retune_strategy
+            # precompute the byte-estimate strategy re-pick once — the
+            # partition geometry it reads never changes mid-run
+            tuner.strategy_alt = retune_strategy(
+                art.n_b, art.pad_inner, art.pad_boundary, cfg.sampling_rate,
+                current=fns.halo_strategy, wire=hspec.wire)
 
     # ---- mesh-distributed eval resources (--eval-device mesh) ----
     mesh_eval = cfg.eval and cfg.eval_device == "mesh"
@@ -530,6 +575,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                         # sampling/dropout key streams (resilience.py) and
                         # round-trips through checkpoint extra so a resumed
                         # run continues the post-rollback streams bit-for-bit
+    tune_state = None   # --tune controller history from checkpoint extra:
+                        # only the single-host path reads it (auto is
+                        # single-process; a multi-rank schedule run
+                        # reconstructs the same history from the schedule
+                        # text, which every rank already has)
     if cfg.resume and coordinator is not None:
         # ---- rank-consistent recovery: rank 0 WALKS the chain, everyone
         # else loads exactly rank 0's choice. Two ranks walking
@@ -704,6 +754,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             seed = int(payload.get("seed", seed))
             retry_nonce = int((payload.get("extra") or {})
                               .get("retry_nonce", 0))
+            tune_state = (payload.get("extra") or {}).get("tune")
             log(f"Resumed from {latest} at epoch {start_epoch}")
             # recover the best-so-far params (final ckpt) so a resumed run that
             # never beats the old best still saves/evaluates a best model
@@ -886,6 +937,100 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     # every rollback, which is what keeps --resume/rollback deterministic.
     halo_cache = None
     cache_reason = "resume" if start_epoch > 0 else "start"
+
+    def _ckpt_extra():
+        """Checkpoint `extra` payload: retry nonce + (under --tune) the
+        controller's sticky decision history, so a resumed run replays the
+        same schedule deterministically."""
+        ex = {"retry_nonce": retry_nonce}
+        if tuner is not None:
+            ex["tune"] = tuner.state_dict()
+        return ex
+
+    # ---- --tune actuation: rebuild the comm stack at an epoch boundary.
+    # build_step_fns hits the shared layout cache (the SpMM layout keys do
+    # not depend on any tuned lever), the halo cache is invalidated so the
+    # next epoch is a logged full refresh, strict-exec's per-variant compile
+    # allowance is re-armed (a retune is the one sanctioned recompile), and
+    # the comm microbench is recompiled HERE, outside the timed region. ----
+    retune_cool = -1    # epochs <= this carry retune compiles in dt: excluded
+                        # from the timer/histogram like warmup epochs
+
+    def _apply_tune(changes, reason, trigger, at_epoch):
+        nonlocal cfg, fns, hspec, tables, tables_full_d, tables_refresh_d
+        nonlocal halo_label, halo_wire_mb, steady_wire_mb
+        nonlocal use_refresh, grad_only, exch_widths
+        nonlocal halo_cache, cache_reason, retune_cool
+        from bnsgcn_tpu.parallel.halo import make_refresh_spec, wire_bytes
+        cfg = cfg.replace(**changes)
+        fns, hspec, tb, tbf = build_step_fns(cfg, spec, art, mesh,
+                                             layout_cache=layout_cache)
+        tables = place_replicated(tb, mesh)
+        tables_full_d = place_replicated(tbf, mesh)
+        tables_refresh_d = (place_replicated(fns.tables_refresh, mesh)
+                            if fns.tables_refresh is not None else None)
+        use_refresh = fns.train_step_full is not None
+        grad_only = fns.halo_mode == "grad-only"
+        halo_label = hspec.strategy
+        if fns.overlap == "split":
+            halo_label += "+ovl"
+        if fns.n_replicas > 1:
+            halo_label += f"+rep{fns.n_replicas}"
+        if fns.n_feat > 1:
+            halo_label += f"+feat{fns.n_feat}"
+        if grad_only:
+            halo_label += "+go"
+        elif use_refresh:
+            halo_label += f"+hr{fns.halo_refresh}"
+        if cfg.reorder != "off":
+            halo_label += "+ro"
+        halo_wire_mb = wire_bytes(hspec, hid_w, nb) / 1e6
+        steady_wire_mb = halo_wire_mb
+        if grad_only:
+            steady_wire_mb = 0.0
+        elif use_refresh:
+            hspec_r, _ = make_refresh_spec(
+                art.n_b, art.pad_inner, art.pad_boundary, cfg.sampling_rate,
+                fns.halo_refresh, strategy=hspec.strategy, wire=hspec.wire)
+            steady_wire_mb = wire_bytes(hspec_r, hid_w, nb) / 1e6
+        # the old cache was built by the OLD exchange geometry: the next
+        # epoch must be a full refresh under the new one. resume/rollback
+        # keep their own lifecycle reason; fresh decisions log as 'retune'
+        halo_cache = None
+        cache_reason = (reason if reason in ("resume", "rollback")
+                        else "retune")
+        if strict is not None and strict.steps:
+            # new compiled programs: each variant's next step legitimately
+            # compiles once more (before the first step nothing is armed)
+            strict.rearm(reason)
+        exch_widths = ([_wire_w(cfg.n_hidden)]
+                       * max(spec.n_graph_layers - 1, 0))
+        if not spec.use_pp and spec.model != "gat" and spec.n_graph_layers > 0:
+            exch_widths.append(_wire_w(max(cfg.n_feat, 1)))
+        if grad_only:
+            exch_widths = []
+        for w in set(exch_widths):
+            _comm_bench(w).block_until_ready()
+        retune_cool = at_epoch + 1
+        if resil is not None:
+            resil.watchdog.touch()      # rebuild+compile is boundary work
+        log(f"[tune] epoch {at_epoch}: {reason} -> " + ", ".join(
+            f"{k}={v}" for k, v in sorted(changes.items()))
+            + f" (halo {halo_label}/{hspec.wire}, steady "
+              f"{steady_wire_mb:.2f} MB/exchange)")
+        if obs is not None:
+            obs.emit("tune_decision", epoch=int(at_epoch), reason=reason,
+                     changes=dict(changes), trigger=dict(trigger or {}),
+                     halo=halo_label, wire=hspec.wire,
+                     wire_mb_steady=round(steady_wire_mb, 4))
+
+    if tuner is not None and start_epoch > 0:
+        # resumed run: reconstruct/adopt the controller history and actuate
+        # the levers that were live at the resume point BEFORE the first
+        # step — the healed run replays the same schedule deterministically
+        _tdiff = tuner.restore(start_epoch, tune_state)
+        if _tdiff:
+            _apply_tune(_tdiff, "resume", {}, start_epoch)
     # The loop is a `while` so the divergence guard can move `epoch`
     # BACKWARD (rollback to the last good checkpoint, resilience.py); with
     # --resilience off no hook below fires and the schedule is exactly the
@@ -1036,7 +1181,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                                              opt_state=opt_state,
                                              bn_state=state, epoch=epoch,
                                              best_acc=best_acc, seed=seed,
-                                             extra={"retry_nonce": retry_nonce})
+                                             extra=_ckpt_extra())
                         ckpt.prune_checkpoints(cfg, cfg.keep_ckpt)
                     log(f"[resilience] agreed preemption (requested by "
                         f"rank(s) {decision.get('ranks')}) at the epoch-"
@@ -1083,6 +1228,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                     # replayed epoch re-runs full-refresh, bitwise like a
                     # fresh run from that checkpoint)
                     halo_cache, cache_reason = None, "rollback"
+                    if tuner is not None:
+                        # revert to the levers live when `restart` first ran;
+                        # the kept history REPLAYS from there (deterministic)
+                        _td = tuner.rewind(restart)
+                        if _td:
+                            _apply_tune(_td, "rollback", {}, restart)
                     resil.watchdog.touch()      # restore+ack was boundary
                     epoch = restart             # work, not step time
                     continue
@@ -1103,6 +1254,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 # stale halo cache from the diverged timeline: invalidate so
                 # the replayed epoch rebuilds it (full-refresh, deterministic)
                 halo_cache, cache_reason = None, "rollback"
+                if tuner is not None:
+                    # revert to the levers live when `restart` first ran; the
+                    # kept history REPLAYS from there (deterministic heal)
+                    _td = tuner.rewind(restart)
+                    if _td:
+                        _apply_tune(_td, "rollback", {}, restart)
                 resil.watchdog.touch()      # restore+backoff was boundary
                 epoch = restart             # work, not step time
                 continue
@@ -1208,12 +1365,23 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # overhead in dt — exclude them from the reported means like
             # warmup epochs (same rule as bench.py, whose traced runs are
             # tagged profiled-diagnostic and never update best_known)
+            # retune epochs compile the rebuilt step programs inside dt —
+            # excluded from the reported means exactly like warmup epochs
             clean_step = (not (trace_dir and prof_start <= epoch <= prof_stop)
-                          and not usr1_in_step)
+                          and not usr1_in_step and epoch > retune_cool)
             if clean_step:
                 timer.record(epoch, dt, comm_t,
                              reduce_traced if reduce_traced is not None else 0.0)
             res.losses.append(loss_f)
+            # wire_mb is THIS epoch's actual exchange cost: duty-cycled under
+            # --halo-refresh (peak on full-refresh epochs, the ~1/K steady
+            # cost otherwise), 0 under grad-only — the per-epoch evidence for
+            # the K-vs-bytes regression, and the --tune controller's wire
+            # trigger
+            epoch_wire_mb = (halo_wire_mb if (not use_refresh and
+                                              not grad_only)
+                             else halo_wire_mb if refresh_full
+                             else steady_wire_mb)
 
             if obs is not None:
                 # the per-epoch record everything downstream joins on; the
@@ -1223,14 +1391,6 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 # epochs must not report as p99 step time
                 if clean_step and epoch >= timer.warmup:
                     obs.registry.histogram("train/step_s").observe(dt)
-                # wire_mb is THIS epoch's actual exchange cost: duty-cycled
-                # under --halo-refresh (peak on full-refresh epochs, the
-                # ~1/K steady cost otherwise), 0 under grad-only — the
-                # per-epoch evidence for the K-vs-bytes regression
-                epoch_wire_mb = (halo_wire_mb if (not use_refresh and
-                                                  not grad_only)
-                                 else halo_wire_mb if refresh_full
-                                 else steady_wire_mb)
                 rec = {"epoch": epoch, "loss": round(loss_f, 6),
                        "step_s": round(dt, 6),
                        "wire_mb": round(epoch_wire_mb, 4)}
@@ -1241,6 +1401,20 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                     rec["comm_tag"] = ("traced" if comm_traced is not None
                                        else "sampled")
                 obs.emit("epoch", **rec)
+
+            # ---- --tune decision point: the epoch's measured metrics feed
+            # the controller AFTER the epoch record lands on the bus; a
+            # decision retunes the comm stack now and takes effect from the
+            # next epoch (the rebuild/compile happens here, at the boundary,
+            # never inside a timed step) ----
+            if tuner is not None:
+                _dec = tuner.on_epoch_end(epoch, {
+                    "loss": loss_f, "step_s": dt,
+                    "comm_s": comm_t if comm_t else None,
+                    "wire_mb": epoch_wire_mb})
+                if _dec is not None and _dec["changes"]:
+                    _apply_tune(_dec["changes"], _dec["reason"],
+                                _dec.get("trigger") or {}, epoch + 1)
 
             if (epoch + 1) % cfg.log_every == 0:
                 mt, mc, mr = timer.means()
@@ -1263,7 +1437,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                                      params=params, opt_state=opt_state,
                                      bn_state=state, epoch=epoch,
                                      best_acc=best_acc, seed=seed,
-                                     extra={"retry_nonce": retry_nonce})
+                                     extra=_ckpt_extra())
                 ckpt.prune_checkpoints(cfg, cfg.keep_ckpt)
                 wrote_ckpt = True
             if mesh_eval and (epoch + 1) % cfg.log_every == 0:
@@ -1327,7 +1501,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                                          opt_state=opt_state, bn_state=state,
                                          epoch=epoch, best_acc=best_acc,
                                          seed=seed,
-                                         extra={"retry_nonce": retry_nonce})
+                                         extra=_ckpt_extra())
                     ckpt.prune_checkpoints(cfg, cfg.keep_ckpt)
                 log(f"[resilience] {resil.preempt_requested} honored at the "
                     f"epoch-{epoch} step boundary: resumable checkpoint at "

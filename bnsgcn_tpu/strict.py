@@ -83,6 +83,7 @@ class StrictExec:
         self.first_compiles: dict[str, int] = {}
         self.fetches = 0
         self.violations = 0
+        self.rearms = 0
         _install_listener()
 
     # listener path (same thread: XLA compiles synchronously under trace)
@@ -128,6 +129,17 @@ class StrictExec:
                 self.first_compiles.get(variant, 0) + n
             self._armed.add(variant)
 
+    def rearm(self, reason: str = "retune"):
+        """Re-arm every variant's first-compile allowance: the `--tune`
+        controller rebuilt the step fns (new compiled programs), so their
+        next guarded step legitimately compiles ONCE more. Counted in the
+        audit — a clean tuned run shows exactly `rearms` sanctioned
+        recompile rounds and still zero violations."""
+        self.rearms += 1
+        self._armed.clear()
+        self.log(f"[strict] compile allowance re-armed ({reason}): the next "
+                 f"step of each variant may compile once")
+
     def fetch(self, x):
         """Audited explicit device->host fetch (the loss read). Explicit
         transfers pass the guard by design; counting them keeps the
@@ -142,6 +154,7 @@ class StrictExec:
             "first_compiles": dict(self.first_compiles),
             "fetches": self.fetches,
             "violations": self.violations,
+            "rearms": self.rearms,
         }
 
     def finish(self):
@@ -155,6 +168,7 @@ class StrictExec:
             f"{len(s['variants'])} variant(s) {s['variants']}, "
             f"first-step compiles {s['first_compiles']}, "
             f"{s['fetches']} audited fetches, "
+            f"{s['rearms']} retune re-arm(s), "
             f"{s['violations']} violation(s)")
         if self.obs is not None:
             self.obs.emit("strict_exec", **s)

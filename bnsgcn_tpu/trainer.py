@@ -205,6 +205,11 @@ class StepFns:
                               # places them replicated). None at K == 1
     halo_refresh: int = 1     # resolved --halo-refresh period K
     halo_mode: str = "exchange"  # resolved --halo-mode
+    halo_strategy: str = "padded"  # RESOLVED exchange strategy (the concrete
+                              # pick under --halo-exchange auto) — the --tune
+                              # controller's lever baseline; run.py/bench.py
+                              # label from it without re-deriving the auto
+                              # selection
 
 
 def _local_env(spec: ModelSpec, hspec: HaloSpec, blk: dict, plan,
@@ -1174,6 +1179,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                   param_spec=param_spec,
                   halo_refresh=refresh_k,
                   halo_mode=halo_mode,
+                  halo_strategy=halo_strategy,
                   **refresh_fns)
     return fns, hspec, tables, tables_full
 

@@ -48,7 +48,7 @@ from bnsgcn_tpu.obs import EVENT_KINDS, load_events  # noqa: E402
 LIFECYCLE_KINDS = ("inject", "rollback", "preempt", "watchdog_fire",
                    "divergence_abort", "coord_decision", "profile_request",
                    "profile", "halo_refresh", "strict_exec",
-                   "reorder", "layout_build")
+                   "reorder", "layout_build", "tune_decision")
 
 # the report's sub-vocabularies must stay inside the bus registry —
 # graftlint checks the emit sites, this checks the reader
@@ -182,6 +182,24 @@ def render(s: dict, write=print):
             + (" (cached)" if ev.get("cached") else "") for ev in lb)
         write(f"layout build: {stages} | total "
               f"{sum(_num(ev.get('ms')) for ev in lb):.1f} ms")
+    # --tune decision trail as a schedule table (also dropped from the
+    # generic lifecycle dump): WHEN each comm lever moved, WHY, and the
+    # trigger metrics the controller read — the per-run audit of the
+    # closed-loop tuner
+    td = [ev for ev in s["lifecycle"] if ev["kind"] == "tune_decision"]
+    if td:
+        write("")
+        write(f"tune schedule ({len(td)} applied decision(s)):")
+        write("  epoch   change                          reason")
+        for ev in td:
+            ch = " ".join(f"{k}={v}" for k, v in sorted(
+                (ev.get("changes") or {}).items()))
+            trig = ev.get("trigger") or {}
+            tr = ("  [" + " ".join(f"{k}={v}"
+                                   for k, v in sorted(trig.items())) + "]"
+                  if trig else "")
+            write(f"  {int(_num(ev.get('epoch'))):5d}   {ch:<30}  "
+                  f"{ev.get('reason')}{tr}")
     epochs = s["epochs"]
     if epochs:
         ranks = sorted({r for by_r in epochs.values() for r in by_r})
@@ -268,7 +286,8 @@ def render(s: dict, write=print):
                 pass
         write(line)
     life = [ev for ev in s["lifecycle"]
-            if ev["kind"] not in ("reorder", "layout_build")]
+            if ev["kind"] not in ("reorder", "layout_build",
+                                  "tune_decision")]
     if life:
         write("")
         write("lifecycle:")
@@ -365,6 +384,21 @@ def compare(sa: dict, sb: dict, name_a: str, name_b: str, write=print):
         write(f"  NOTE: reorder differs (A {ra} vs B {rb}) — step-time "
               f"deltas include the tile-coverage effect, and loss deltas "
               f"at round-off scale are expected from the row permutation")
+    # tuned-vs-static diff: a run with tune_decision events changes
+    # K/mode/strategy/wire MID-RUN, so the header comparison above only
+    # describes its launch point — name every retune epoch explicitly
+    ta = [ev for ev in sa["lifecycle"] if ev["kind"] == "tune_decision"]
+    tb = [ev for ev in sb["lifecycle"] if ev["kind"] == "tune_decision"]
+    if ta or tb:
+        def _trail(evs):
+            return ", ".join(
+                f"E{int(_num(ev.get('epoch')))}:" + "/".join(
+                    f"{k}={v}" for k, v in sorted(
+                        (ev.get("changes") or {}).items()))
+                for ev in evs) or "static"
+        write(f"  NOTE: --tune retuned the comm stack mid-run "
+              f"(A: {_trail(ta)} | B: {_trail(tb)}) — step/wire deltas past "
+              f"those epochs are schedule effects, not noise")
     if sa["bench"] or sb["bench"]:
         by = {}
         for tag, s in (("a", sa), ("b", sb)):
