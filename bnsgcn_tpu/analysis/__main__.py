@@ -2,6 +2,11 @@
 
 Exit codes: 0 clean, 1 active findings, 2 files failed to parse.
 `tools/lint.sh` is the thin CI wrapper around this entry point.
+
+``python -m bnsgcn_tpu.analysis ir`` runs the second tier — the
+jaxpr-level contract audit over every tune-reachable compiled program
+(analysis/ir). It shares the exit-code contract: 0 clean, 1 findings,
+2 variants failed to trace.
 """
 
 from __future__ import annotations
@@ -16,7 +21,67 @@ from bnsgcn_tpu.analysis.core import (DEFAULT_TARGETS, RULE_DOCS,
                                       write_report)
 
 
+def ir_main(argv) -> int:
+    """The `ir` subcommand: trace + verify the variant matrix. Forces the
+    CPU backend before jax initializes — the audit is abstract (no devices
+    needed) and must not grab a TPU out from under a queued run."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="python -m bnsgcn_tpu.analysis ir",
+        description="graftlint-ir — jaxpr-level collective/memory contract "
+                    "audit of every tune-reachable compiled program")
+    ap.add_argument("--root", default=None,
+                    help="repo root for the report (default: inferred)")
+    ap.add_argument("--json", dest="json_path", default=None, metavar="PATH",
+                    help="write the machine-readable report here "
+                         "('-' for stdout)")
+    ap.add_argument("--tune-schedule", default=None, metavar="SPEC",
+                    help="also audit the lever states this --tune-schedule "
+                         "string reaches")
+    ap.add_argument("--max-variants", type=int, default=None, metavar="N",
+                    help="trace at most N matrix cells (smoke runs; the "
+                         "report records how many were dropped)")
+    ap.add_argument("--obs-log", default=None, metavar="PATH",
+                    help="land the ir_audit event on this telemetry log "
+                         "(default: $BNSGCN_OBS_LOG)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress per-variant progress lines")
+    args = ap.parse_args(argv)
+
+    from bnsgcn_tpu.analysis.ir import run_ir_audit
+    progress = None if args.quiet else (
+        lambda msg: print(msg, file=sys.stderr))
+    report = run_ir_audit(root=args.root, tune_schedule=args.tune_schedule,
+                          max_variants=args.max_variants,
+                          obs_log=args.obs_log, progress=progress)
+
+    from bnsgcn_tpu.analysis.core import RULE_DOCS
+    for f in report["findings"]:
+        print(f"{f['file']}: [{f['rule']}] {f['message']}")
+        hint = RULE_DOCS.get(f["rule"], ("", ""))[1]
+        if hint:
+            print(f"    fix: {hint}")
+
+    if args.json_path == "-":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    elif args.json_path:
+        write_report(report, args.json_path)
+
+    tag = "clean" if report["ok"] else "FAIL"
+    print(f"graftlint-ir: {tag} — {report['n_variants']} variant(s) in "
+          f"{report['elapsed_s']}s, {len(report['findings'])} finding(s), "
+          f"{len(report['errors'])} trace error(s)", file=sys.stderr)
+    if report["errors"]:
+        return 2
+    return 1 if report["findings"] else 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "ir":
+        return ir_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m bnsgcn_tpu.analysis",
         description="graftlint — SPMD-aware static analysis for this repo")

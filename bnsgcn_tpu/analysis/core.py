@@ -96,7 +96,53 @@ RULE_DOCS = {
         "use the named constants (resilience.EXIT_PREEMPTED/"
         "EXIT_DIVERGED/EXIT_WATCHDOG/EXIT_COORD_ABORT) so the exit-code "
         "contract is greppable"),
+    # -- family 7: repo contract checks (analysis/repo_checks.py) --
+    "tune-schedule-invalid": (
+        "--tune-schedule string literal does not parse under the real "
+        "tune.py grammar",
+        "fix the schedule spelling (epoch:lever=value, comma-separated; "
+        "levers K/mode/strategy/wire) — the run would die at startup with "
+        "the same error this lint reports early"),
+    "config-doc-drift": (
+        "config.py flag vocabulary and the README knob table disagree "
+        "(undocumented flag, stale flag, or stale choices)",
+        "update the README 'Config knobs' table to match "
+        "config.create_parser() — the table is contract, not prose"),
+    # -- family 8: jaxpr-level contracts (analysis/ir, `ir` subcommand) --
+    "ir-rank-asymmetry": (
+        "traced collective schedule is not rank-symmetric "
+        "(axis_index_groups, rank-predicated branch, or a retrace "
+        "divergence between tune-equivalent states)",
+        "make every collective unconditional and sub-group-free inside "
+        "shard_map, and keep the schedule a pure function of the lever "
+        "state — asymmetric schedules deadlock the mesh at scale"),
+    "ir-dead-donation": (
+        "donate_argnums buffer has no aliased output in the lowered "
+        "module (donation buys nothing, buffer still invalidated)",
+        "drop the argument from donate_argnums or return an output with "
+        "the same shape/dtype so XLA can alias it"),
+    "ir-wire-drift": (
+        "payload bytes in the traced exchange differ from the "
+        "halo.traced_wire_bytes plan oracle (the run-header/tuner claim)",
+        "the compiled exchange and the reported bytes must agree: check "
+        "the wire-codec cast points and the spec geometry "
+        "(pad_send/shift_pads/pair_send) for the strategy"),
+    "ir-hidden-transfer": (
+        "device<->host primitive (strict.TRANSFER_PRIMITIVES) inside a "
+        "traced step/eval/exchange program",
+        "hoist the host interaction outside the jitted program — inside, "
+        "it is a per-step sync the CPU transfer guard cannot even see"),
+    "ir-trace-error": (
+        "a variant-matrix cell failed to trace at all",
+        "the build/trace path for this lever combination is broken — "
+        "reproduce with `python -m bnsgcn_tpu.analysis ir` and fix the "
+        "exception before trusting any run that can retune into it"),
     # -- framework --
+    "suppression-stale": (
+        "graftlint: disable= comment whose line no longer triggers any "
+        "of its suppressed rules",
+        "delete the stale suppression — it would silently swallow a "
+        "future regression at that line"),
     "suppression-missing-reason": (
         "graftlint: disable= without a (reason)",
         "every suppression must say why: "
@@ -322,6 +368,13 @@ def lint_paths(paths: list[str] | None = None, root: str | None = None,
     for mod in modules:
         raw.extend(_suppression_findings(mod))
 
+    # repo-level contract checks (non-Python surfaces: shell scripts, the
+    # watch queue, the README knob table) ride the default full-surface
+    # run — linting an explicit file subset stays file-scoped
+    if sorted(paths) == sorted(resolve_paths(None, root)):
+        from bnsgcn_tpu.analysis import repo_checks
+        raw.extend(repo_checks.check_repo(root))
+
     if select:
         raw = [f for f in raw
                if f.rule in select or f.rule.startswith("suppression-")]
@@ -337,6 +390,27 @@ def lint_paths(paths: list[str] | None = None, root: str | None = None,
             suppressed.append(f)
         else:
             active.append(f)
+
+    # staleness audit: a suppression comment whose line no longer
+    # triggers ANY of its listed rules is itself a finding — left
+    # behind, it would silently swallow the NEXT regression at that
+    # line. Line-level, not per-rule: a multi-rule list where one rule
+    # still fires is load-bearing and stays. Only meaningful on
+    # unfiltered runs (under --select, unselected rules never get the
+    # chance to mark their suppressions used). Reasonless suppressions
+    # are already flagged suppression-missing-reason and skipped here.
+    if select is None:
+        for mod in modules:
+            used_lines = {s.line for s in mod.suppressions if s.used}
+            for s in mod.suppressions:
+                if (s.line in used_lines or not s.reason
+                        or s.rule not in RULE_DOCS):
+                    continue
+                active.append(Finding(
+                    mod.relpath, s.line, 0, "suppression-stale",
+                    f"disable={s.rule} no longer matches a finding on its "
+                    f"line (reason was: {s.reason!r}) — delete it"))
+        active.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
     return active, suppressed, errors
 
 

@@ -1,0 +1,125 @@
+"""The four graftlint-ir contracts, as pure functions over TracedProgram.
+
+Each checker returns ``core.Finding`` rows whose ``file`` is a virtual
+path ``ir://<variant-key>#<program>`` — the variant matrix cell and the
+program inside it that violated the contract — so the CLI/JSON plumbing
+built for the AST tier renders IR findings unchanged. The checkers know
+nothing about how the programs were traced: the seeded-violation tests
+feed them hand-built fixture programs through the same signatures the
+real variant runner uses.
+"""
+
+from __future__ import annotations
+
+from bnsgcn_tpu.analysis.core import Finding
+from bnsgcn_tpu.analysis.ir.trace import TracedProgram, payload_wire_bytes
+
+
+def _f(where: str, rule: str, message: str) -> Finding:
+    return Finding(file=where, line=0, col=0, rule=rule, message=message)
+
+
+def check_rank_symmetry(tp: TracedProgram, where: str) -> list:
+    """Contract 1 (per-program half): every collective in the traced
+    schedule must execute identically on every rank. Two jaxpr-visible
+    violations: a non-None ``axis_index_groups`` partitions the mesh into
+    subgroups (sub-mesh schedules that the other ranks never join), and a
+    collective under a cond/switch whose predicate is data-dependent on
+    ``axis_index`` only runs on the ranks that take the branch — the
+    canonical SPMD deadlock."""
+    out = []
+    for i, c in enumerate(tp.collectives):
+        if c.groups:
+            out.append(_f(where, "ir-rank-asymmetry",
+                          f"collective #{i} {c.prim} on axes {c.axes} uses "
+                          f"axis_index_groups — a sub-grouped schedule is "
+                          f"not rank-symmetric"))
+        if c.rank_branched:
+            out.append(_f(where, "ir-rank-asymmetry",
+                          f"collective #{i} {c.prim} on axes {c.axes} sits "
+                          f"under a cond/switch whose predicate derives "
+                          f"from axis_index — only some ranks execute it"))
+    return out
+
+
+def check_schedule_match(tp_a: TracedProgram, tp_b: TracedProgram,
+                         where: str, what: str = "retrace") -> list:
+    """Contract 1 (cross-trace half): two traces that must compile to the
+    same program — the same lever state reached at launch vs through a
+    `--tune` retune, or simply tracing twice — must produce the identical
+    ordered (primitive, axes, shape, dtype) collective sequence. A
+    divergence means the schedule depends on something outside the lever
+    state, and a mid-run retune would desynchronize the pod."""
+    a, b = tp_a.schedule(), tp_b.schedule()
+    if a == b:
+        return []
+    n = min(len(a), len(b))
+    at = next((i for i in range(n) if a[i] != b[i]), n)
+    detail = (f"first divergence at collective #{at}: "
+              f"{a[at] if at < len(a) else '<absent>'} vs "
+              f"{b[at] if at < len(b) else '<absent>'}")
+    return [_f(where, "ir-rank-asymmetry",
+               f"collective schedule differs between {tp_a.name} and "
+               f"{tp_b.name} ({what}): {len(a)} vs {len(b)} collectives; "
+               + detail)]
+
+
+def check_donation(tp: TracedProgram, where: str) -> list:
+    """Contract 2: every ``donate_argnums`` buffer must actually alias an
+    output in the lowered module (``tf.aliasing_output``). A donated arg
+    XLA could not alias is a dead donation: the caller's buffer is
+    invalidated anyway, but the output is a fresh allocation — the step
+    silently runs at un-donated peak memory."""
+    out = []
+    if tp.donation is None:
+        return out
+    for i in tp.donation.dead:
+        path = tp.donation.paths.get(i, f"#flat{i}")
+        out.append(_f(where, "ir-dead-donation",
+                      f"donated arg {i} ({path}) has no aliased output in "
+                      f"the lowered module — the donation buys nothing and "
+                      f"the buffer is still invalidated"))
+    return out
+
+
+def check_wire(tp: TracedProgram, width: int, oracle_bytes: int,
+               where: str, oracle: str = "halo.traced_wire_bytes") -> list:
+    """Contract 3: the payload bytes the traced exchange collectives
+    actually move must equal the plan oracle's claim — the number the run
+    header prints and the auto-tuner's cost model consumes. Drift means
+    the wire-codec or strategy plumbing ships different bytes than it
+    reports."""
+    traced = payload_wire_bytes(tp, width)
+    if traced == oracle_bytes:
+        return []
+    return [_f(where, "ir-wire-drift",
+               f"traced halo payload is {traced} B/device but {oracle} "
+               f"claims {oracle_bytes} B — the compiled exchange and the "
+               f"reported wire bytes disagree")]
+
+
+def check_no_payload(tp: TracedProgram, width: int, where: str) -> list:
+    """Contract 3, grad-only corner: a --halo-mode grad-only step must
+    ship ZERO forward-halo payload (that is the mode's entire bandwidth
+    claim); any width-`width` exchange operand in its trace is drift."""
+    traced = payload_wire_bytes(tp, width)
+    if traced == 0:
+        return []
+    return [_f(where, "ir-wire-drift",
+               f"grad-only step traces {traced} B/device of forward-halo "
+               f"payload — the mode claims zero")]
+
+
+def check_transfers(tp: TracedProgram, where: str) -> list:
+    """Contract 4: no device<->host primitive inside a traced hot-loop
+    program (strict.TRANSFER_PRIMITIVES). The runtime transfer guard can
+    only observe these on hardware; the static audit proves their absence
+    on every variant without a pod."""
+    out = []
+    for prim, stack in tp.transfers:
+        inside = "/".join(stack) or "<top>"
+        out.append(_f(where, "ir-hidden-transfer",
+                      f"host-transfer primitive '{prim}' inside traced "
+                      f"scope (under {inside}) — invisible to the CPU "
+                      f"transfer guard, a sync on TPU"))
+    return out

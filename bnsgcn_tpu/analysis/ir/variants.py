@@ -1,0 +1,112 @@
+"""Variant-matrix enumeration for the graftlint-ir preflight.
+
+A *variant* is one compiled-program family the training loop can execute:
+a point in (halo strategy x wire codec x overlap mode x refresh period x
+halo mode). The matrix is built from two sources and deduplicated:
+
+* the static product of the config vocabulary — strategy / wire / overlap
+  choices read from ``config.create_parser()`` itself (never a hand-kept
+  copy that drifts), refresh in {1, 2}, plus the grad-only mode; and
+* every `--tune`-reachable lever state (``tune.reachable_lever_states``)
+  for the auto controller launched from the defaults and, when the caller
+  passes one, a concrete ``--tune-schedule`` string — a retune swaps the
+  compiled programs mid-run, so each target state is a program the audit
+  must cover.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Variant:
+    strategy: str          # halo_exchange: padded | shift | ragged
+    wire: str              # halo_wire: native | bf16 | fp8 | int8
+    overlap: str           # off | split
+    refresh: int           # --halo-refresh K
+    mode: str              # halo_mode: exchange | grad-only
+    source: str = "matrix"  # matrix | tune
+
+    @property
+    def key(self) -> str:
+        """The variant's virtual-path stem for finding attribution."""
+        return (f"{self.strategy}/{self.wire}/ovl-{self.overlap}"
+                f"/K{self.refresh}/{self.mode}")
+
+    @property
+    def levers(self) -> dict:
+        return {"halo_exchange": self.strategy, "halo_wire": self.wire,
+                "halo_refresh": self.refresh, "halo_mode": self.mode}
+
+
+def config_choices() -> dict:
+    """Flag -> choices tuple, read off the live argparse parser so the
+    matrix can never drift from what the CLI accepts."""
+    from bnsgcn_tpu.config import create_parser
+    out = {}
+    for action in create_parser()._actions:
+        if action.choices is None or not action.option_strings:
+            continue
+        for opt in action.option_strings:
+            if opt.startswith("--"):
+                out[opt[2:]] = tuple(action.choices)
+    return out
+
+
+def _norm(strategy, wire, overlap, refresh, mode, source) -> "Variant":
+    refresh = int(refresh)
+    if mode == "grad-only":
+        # trainer forces refresh back to 1 in grad-only (no activation
+        # exchange to stagger) — normalize so dedup sees the real program
+        refresh = 1
+    return Variant(strategy=strategy, wire=wire, overlap=overlap,
+                   refresh=refresh, mode=mode, source=source)
+
+
+def enumerate_variants(tune_schedule: str | None = None,
+                       refresh_periods: tuple = (1, 2)) -> list:
+    """The deduplicated audit matrix, static product first, tune-reachable
+    extras after. 'auto' strategy is a selection policy, not a program —
+    its outcomes are the concrete strategies already in the product."""
+    choices = config_choices()
+    strategies = tuple(s for s in choices.get(
+        "halo-exchange", ("padded", "shift", "ragged")) if s != "auto")
+    wires = choices.get("halo-wire", ("native", "bf16", "fp8", "int8"))
+    overlaps = choices.get("overlap", ("off", "split"))
+
+    seen: dict = {}
+
+    def add(v: Variant):
+        k = (v.strategy, v.wire, v.overlap, v.refresh, v.mode)
+        if k not in seen:
+            seen[k] = v
+
+    for strat in strategies:
+        for wire in wires:
+            for ovl in overlaps:
+                for k in refresh_periods:
+                    add(_norm(strat, wire, ovl, k, "exchange", "matrix"))
+    # grad-only is one program family regardless of wire/refresh (zero
+    # activation exchange); audit it once per strategy so the gradient
+    # all-reduce schedule is checked under each spec geometry
+    for strat in strategies:
+        add(_norm(strat, "native", "off", 1, "grad-only", "matrix"))
+
+    for st in _tune_states(tune_schedule):
+        add(_norm(st["halo_exchange"], st["halo_wire"], "off",
+                  st["halo_refresh"], st["halo_mode"], "tune"))
+    return list(seen.values())
+
+
+def _tune_states(tune_schedule: str | None) -> list:
+    """Lever states a `--tune` controller can retune into, from the
+    default launch point: the full auto-controller reachability set, plus
+    the concrete schedule's states when one is given."""
+    from bnsgcn_tpu.config import Config
+    from bnsgcn_tpu.tune import reachable_lever_states
+    states = list(reachable_lever_states(Config(tune="auto")))
+    if tune_schedule:
+        states.extend(reachable_lever_states(
+            Config(tune="schedule", tune_schedule=tune_schedule)))
+    return states

@@ -68,6 +68,8 @@ EVENT_KINDS = (
     "bench_header", "bench_variant", "bench_end",
     # strict-execution guard (strict.py, --strict-exec)
     "strict_exec",
+    # jaxpr-level static preflight (analysis/ir, `-m bnsgcn_tpu.analysis ir`)
+    "ir_audit",
 )
 
 

@@ -44,8 +44,11 @@ FEAT_AXIS = "feat"
 
 
 def n_feat(mesh: Mesh) -> int:
-    """Feat-axis size of a mesh; 1 for the 2-D/1-D meshes."""
-    return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get(FEAT_AXIS, 1))
+    """Feat-axis size of a mesh; 1 for the 2-D/1-D meshes.
+
+    Uses `mesh.shape` (name -> size) rather than `mesh.devices` so the
+    analysis/ir abstract tracer can pass a host-only AbstractMesh."""
+    return int(dict(mesh.shape).get(FEAT_AXIS, 1))
 
 
 def feat_axis(mesh: Mesh):

@@ -165,6 +165,32 @@ def wire_bytes(spec: HaloSpec, width: int, native_bytes: int = 4) -> int:
     return spec.n_parts * spec.pad_send * width * b
 
 
+def traced_wire_bytes(spec: HaloSpec, width: int, native_bytes: int = 4,
+                      ragged_native: Optional[bool] = None) -> int:
+    """Per-device payload bytes the COMPILED exchange program actually moves
+    — the analysis/ir wire-byte contract's oracle, cross-checked against the
+    collective operands extracted from the traced jaxpr.
+
+    Equals `wire_bytes()` for 'padded' and 'shift' (their traced operands
+    ARE the accounting). 'ragged' differs by construction: the native
+    collective ships the lane-aligned [T_pad, d] operand (the bottleneck
+    device's exact rows INCLUDING the self chunk, rounded up to 8), while
+    the emulated path (XLA:CPU / old jax, `ragged_native_ok()` False)
+    routes the same rows over the padded all_to_all — padded accounting,
+    the documented emulation slack `wire_bytes()` deliberately ignores.
+    The [P] f32 scale hop of the quantized wires is excluded on both sides
+    (same convention as `wire_bytes`)."""
+    b = {"native": native_bytes, "bf16": 2, "fp8": 1, "int8": 1}[spec.wire]
+    if spec.strategy == "ragged":
+        if ragged_native is None:
+            ragged_native = ragged_native_ok()
+        if ragged_native:
+            t_pad = _ragged_geometry(spec.pair_send)[3]
+            return t_pad * width * b
+        return spec.n_parts * spec.pad_send * width * b
+    return wire_bytes(spec, width, native_bytes)
+
+
 # auto-selection thresholds: ragged must save >=5% of padded's cross-chip
 # bytes to be worth leaving the best-tuned dense collective; shift pays P-1
 # serialized hop latencies for the same bytes as ragged, so it is only

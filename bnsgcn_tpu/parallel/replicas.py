@@ -84,9 +84,11 @@ def make_mesh(n_parts: int, n_replicas: int = 1, n_feat: int = 1,
 
 
 def n_replicas(mesh: Mesh) -> int:
-    """Replica-axis size of a mesh; 1 for the historical 1-D parts mesh."""
-    return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get(
-        REPLICA_AXIS, 1))
+    """Replica-axis size of a mesh; 1 for the historical 1-D parts mesh.
+
+    Uses `mesh.shape` (name -> size) rather than `mesh.devices` so the
+    analysis/ir abstract tracer can pass a host-only AbstractMesh."""
+    return int(dict(mesh.shape).get(REPLICA_AXIS, 1))
 
 
 def replica_axis(mesh: Mesh):
@@ -101,7 +103,7 @@ def mesh_desc(mesh: Mesh) -> str:
     """Human-readable mesh shape for run headers: '2x4x2 replicas x parts
     x feat' on a 3-D mesh, '2x4 replicas x parts' on 2-D, '4 parts' on the
     historical 1-D mesh."""
-    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    shape = dict(mesh.shape)
     axes = [(REPLICA_AXIS, "replicas"), (PARTS_AXIS, "parts"),
             (FEAT_AXIS, "feat")]
     present = [(shape[a], label) for a, label in axes if a in shape]

@@ -99,6 +99,18 @@ def prepare_partition(cfg: Config, g: Optional[Graph] = None,
 _final_best_payload = ckpt.final_best_payload
 
 
+def step_variants(fns) -> tuple:
+    """Strict-exec step-program variant names the epoch loop can execute
+    with these step fns: the `--halo-refresh` pair ('full' at epoch 0 and
+    after every cache invalidation, 'cached' in steady state) when the
+    cached program exists, else the single 'step' program. The loop below
+    derives the per-epoch pick from the cache state; this is the static
+    vocabulary — what `--strict-exec` arms per variant and what the
+    analysis/ir preflight traces per lever state."""
+    return (("full", "cached") if fns.train_step_full is not None
+            else ("step",))
+
+
 def check_mesh_budget(cfg: Config, devices=None) -> None:
     """ONE named config error when R x P x T exceeds the device budget,
     raised before any mesh/axis-specific constructor can fail with its own

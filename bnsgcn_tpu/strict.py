@@ -39,7 +39,21 @@ from typing import Optional
 
 import jax
 
-__all__ = ["StrictExec", "StrictExecError"]
+__all__ = ["StrictExec", "StrictExecError", "TRANSFER_PRIMITIVES"]
+
+# jaxpr primitives that move data across the device<->host boundary (or
+# re-place it) from INSIDE a traced program — the static face of the same
+# contract the transfer guard enforces at runtime. analysis/ir scans every
+# traced step/eval/exchange program for these: a hit is a hidden transfer
+# the runtime guard would only catch on hardware (CPU cannot observe D2H),
+# so the static audit is the proof that needs no pod window. `device_put`
+# inside a traced scope re-commits placement mid-program (a sync or a
+# cross-mesh copy); the callback family round-trips through the host by
+# definition; infeed/outfeed are the raw host-transfer channels.
+TRANSFER_PRIMITIVES = frozenset({
+    "device_put", "infeed", "outfeed",
+    "pure_callback", "io_callback", "debug_callback", "callback",
+})
 
 
 class StrictExecError(RuntimeError):

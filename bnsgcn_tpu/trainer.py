@@ -1220,3 +1220,40 @@ def init_training(cfg: Config, spec: ModelSpec, mesh: Mesh, seed: int = 0,
         state = place_replicated(state, mesh)
         opt_state = place_replicated(opt_state, mesh)
     return params, state, opt_state
+
+
+def abstract_step_inputs(cfg: Config, spec: ModelSpec, art, fns: StepFns,
+                         tables: dict) -> dict:
+    """ShapeDtypeStruct pytrees matching every argument of the compiled
+    step/eval/exchange programs — the traceable twin of `init_training` +
+    `build_block_arrays` + `place_*` that touches NO device: params/state
+    come from `jax.eval_shape` of the real initializer, the block dict from
+    the real host-side array builder, so `jax.make_jaxpr(fns.train_step)`
+    over these avals yields exactly the program a run would compile
+    (analysis/ir traces it on a host-only AbstractMesh, CI-safe).
+
+    Returns {params, state, opt_state, epoch, blk, tables, key}: `key` is
+    a typed-PRNG-key aval usable for both sample_key and drop_key; `blk`
+    already folds `fns.extra_blk` / `fns.drop_blk_keys` and the bfloat16
+    feature cast the run applies after placement."""
+    aval = lambda v: jax.ShapeDtypeStruct(np.asarray(v).shape,
+                                          np.asarray(v).dtype)
+    blk_np = build_block_arrays(art, spec.model, dtype=np.float32)
+    blk_np.update(fns.extra_blk)
+    for k in fns.drop_blk_keys:
+        blk_np.pop(k, None)
+    blk = {k: aval(v) for k, v in blk_np.items()}
+    if cfg.dtype == "bfloat16":
+        blk["feat"] = jax.ShapeDtypeStruct(blk["feat"].shape, jnp.bfloat16)
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    key = jax.eval_shape(jax.random.key, 0)
+    params, state = jax.eval_shape(
+        lambda k: init_params(k, spec, dtype), key)
+    opt_state = jax.eval_shape(make_tx(cfg).init, params)
+    return {
+        "params": params, "state": state, "opt_state": opt_state,
+        "epoch": jax.ShapeDtypeStruct((), jnp.uint32),
+        "blk": blk,
+        "tables": {k: aval(v) for k, v in tables.items()},
+        "key": key,
+    }

@@ -54,7 +54,8 @@ from typing import Callable, Optional
 from bnsgcn_tpu.config import ConfigError
 
 __all__ = ["Tuner", "AutoState", "decide", "parse_schedule",
-           "startup_changes", "validate_mode", "bench_schedule"]
+           "startup_changes", "validate_mode", "bench_schedule",
+           "reachable_lever_states"]
 
 # schedule grammar lever aliases -> Config field names
 LEVER_ALIASES = {
@@ -191,6 +192,65 @@ def startup_changes(cfg) -> tuple:
                     "auto-start: coarse staleness while gradients are large")
         return {}, "auto-start"
     return {}, ""
+
+
+def reachable_lever_states(cfg) -> list:
+    """Every TUNED_LEVERS state a run under `cfg` can be retuned into, as a
+    deduplicated list of {halo_exchange, halo_wire, halo_refresh, halo_mode}
+    dicts with the effective launch state first.
+
+    This is the static enumeration the analysis/ir preflight traces: a
+    retune swaps the compiled step programs at an epoch boundary, so every
+    state listed here is a program the run may execute and must satisfy the
+    same rank-symmetry / donation / wire contracts as the launch program.
+
+    * ``off``      — the launch levers only.
+    * ``schedule`` — the cumulative fold of ``parse_schedule`` entries onto
+      the launch levers, in epoch order (exactly the states
+      ``Tuner.on_epoch_end`` walks, including after rollback replay).
+    * ``auto``     — a conservative SUPERSET: the startup fold, then every
+      ladder rung at or past the starting position (the ladder is
+      monotone), crossed with the one-shot strategy re-pick (any concrete
+      strategy — the byte-estimate pick depends on the runtime n_b table,
+      so all of VALID_VALUES is reachable in principle) and the one-shot
+      wire anneal. Tracing a superset keeps the preflight sound when the
+      controller's runtime choice cannot be known statically.
+
+    ``halo_exchange='auto'`` is left as-is here; callers resolving it to a
+    concrete strategy (run.py's select_halo_strategy) should fold the
+    resolved value into `cfg` first."""
+    launch = {k: getattr(cfg, k) for k in TUNED_LEVERS}
+    launch["halo_refresh"] = int(launch["halo_refresh"])
+    ch, _ = startup_changes(cfg)
+    start = {**launch, **ch}
+    states: list[dict] = []
+
+    def add(st: dict):
+        if st not in states:
+            states.append(dict(st))
+
+    add(launch)
+    add(start)
+    if cfg.tune == "schedule":
+        cur = dict(start)
+        for _ep, levers in parse_schedule(cfg.tune_schedule):
+            cur.update(levers)
+            add(cur)
+    elif cfg.tune == "auto":
+        strategies = {start["halo_exchange"]}
+        if start["halo_exchange"] in VALID_VALUES["halo_exchange"]:
+            strategies.update(VALID_VALUES["halo_exchange"])
+        wires = {start["halo_wire"]}
+        nxt = WIRE_ANNEAL.get(start["halo_wire"])
+        if nxt is not None:
+            wires.add(nxt)
+        rungs = STALENESS_LADDER[_ladder_pos(start):]
+        for strat in sorted(strategies):
+            for wire in sorted(wires):
+                for mode, k in rungs:
+                    add({"halo_exchange": strat, "halo_wire": wire,
+                         "halo_refresh": k, "halo_mode": mode})
+    return states
 
 
 def bench_schedule(n_epochs: int) -> list:

@@ -524,6 +524,9 @@ def test_lint_sh_clean_at_head(tmp_path):
     fault_matrix.sh and the quickgate tier both invoke)."""
     env = _env()
     env["LINT_REPORT"] = str(tmp_path / "lint_report.json")
+    # gate 1 only: the IR tier's clean-at-HEAD run is its own quickgate
+    # (test_analysis_ir.test_ir_audit_clean_at_head) — no double matrix
+    env["LINT_SKIP_IR"] = "1"
     r = subprocess.run(["bash", "tools/lint.sh"], capture_output=True,
                        text=True, timeout=300, cwd=REPO, env=env)
     assert r.returncode == 0, r.stdout + r.stderr

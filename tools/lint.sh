@@ -1,28 +1,55 @@
 #!/usr/bin/env bash
-# tools/lint.sh — the graftlint CI gate.
+# tools/lint.sh — the graftlint CI gate, both tiers.
 #
-# Runs the repo-native static-analysis suite over the default lint
-# surface (bnsgcn_tpu/, tools/, bench.py, __graft_entry__.py) and writes
-# the machine-readable report to tools/lint_report.json (override with
-# LINT_REPORT=path). Exit code: 0 clean, 1 findings, 2 parse errors —
-# straight from `python -m bnsgcn_tpu.analysis`.
+# Gate 1 (AST): the repo-native static-analysis suite over the default
+# lint surface (bnsgcn_tpu/, tools/, bench.py, __graft_entry__.py),
+# report to tools/lint_report.json (override with LINT_REPORT=path).
+# Gate 2 (IR): the jaxpr-level contract audit (`analysis ir`) — traces
+# every tune-reachable step/eval/exchange program on a host-only
+# abstract mesh and verifies the collective/donation/wire/transfer
+# contracts; report to tools/ir_report.json (override with
+# IR_REPORT=path). Skipped when gate 1 fails (same signal, cheaper) or
+# when explicit paths are passed (file-scoped lint run).
+#
+# Exit code: the first failing gate's — 0 clean, 1 findings, 2 parse or
+# trace errors — straight from `python -m bnsgcn_tpu.analysis`.
+# LINT_SKIP_IR=1 runs gate 1 only (the IR tier traces ~60 programs,
+# ~2 min on a laptop CPU).
 #
 # Usage:
-#   tools/lint.sh                  # full default surface
-#   tools/lint.sh bnsgcn_tpu/run.py  # specific files/dirs
+#   tools/lint.sh                  # full default surface, both gates
+#   tools/lint.sh bnsgcn_tpu/run.py  # specific files/dirs (AST only)
 #   LINT_REPORT=/tmp/r.json tools/lint.sh
 set -u
 cd "$(dirname "$0")/.."
 
 REPORT="${LINT_REPORT:-tools/lint_report.json}"
+IR_REPORT="${IR_REPORT:-tools/ir_report.json}"
 PY="${PYTHON:-python}"
 
-# The linter is pure-AST (no jax import), but keep the env pinned the
-# same way the test tier does so any future runtime hook stays CPU-safe.
+# The AST tier is pure-AST (no jax import), but keep the env pinned the
+# same way the test tier does so the IR tier (which DOES import jax,
+# CPU-only and device-free) and any future runtime hook stay CPU-safe.
 JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
     "$PY" -m bnsgcn_tpu.analysis --json "$REPORT" "$@"
 rc=$?
 if [ "$rc" -ne 0 ]; then
     echo "lint.sh: graftlint gate FAILED (rc=$rc, report: $REPORT)" >&2
+    exit "$rc"
 fi
-exit "$rc"
+
+# gate 2 only on full-surface runs: explicit paths mean a file-scoped
+# AST pass, and the IR matrix is path-independent anyway
+if [ "$#" -eq 0 ] || { [ "$#" -eq 1 ] && [ "${1:-}" = "-q" ]; }; then
+    if [ "${LINT_SKIP_IR:-0}" != "1" ]; then
+        JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
+            "$PY" -m bnsgcn_tpu.analysis ir --json "$IR_REPORT" "$@"
+        rc=$?
+        if [ "$rc" -ne 0 ]; then
+            echo "lint.sh: graftlint-ir gate FAILED (rc=$rc, report:" \
+                 "$IR_REPORT)" >&2
+            exit "$rc"
+        fi
+    fi
+fi
+exit 0
