@@ -7,6 +7,12 @@ Exit codes: 0 clean, 1 active findings, 2 files failed to parse.
 jaxpr-level contract audit over every tune-reachable compiled program
 (analysis/ir). It shares the exit-code contract: 0 clean, 1 findings,
 2 variants failed to trace.
+
+``python -m bnsgcn_tpu.analysis proto`` runs the third tier — the
+coordination-protocol model checker (analysis/proto): the real
+Coordinator/ResilienceManager code under a deterministic scheduler,
+across enumerated interleavings and fault schedules. Same exit-code
+contract: 0 clean, 1 findings, 2 scenarios failed to explore.
 """
 
 from __future__ import annotations
@@ -77,11 +83,102 @@ def ir_main(argv) -> int:
     return 1 if report["findings"] else 0
 
 
+def proto_main(argv) -> int:
+    """The `proto` subcommand: enumerate + judge the protocol schedule
+    trees. Forces the CPU backend for the same reason as `ir` — nothing
+    here needs a device, and preflight must never steal one."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="python -m bnsgcn_tpu.analysis proto",
+        description="graftcheck-proto — deterministic-schedule model "
+                    "checking of the coordination protocol (the real "
+                    "Coordinator/ResilienceManager code, enumerated "
+                    "interleavings x fault schedules)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for the report (default: inferred)")
+    ap.add_argument("--json", dest="json_path", default=None, metavar="PATH",
+                    help="write the machine-readable report here "
+                         "('-' for stdout)")
+    ap.add_argument("--max-schedules", type=int, default=None, metavar="N",
+                    help="total schedule budget across scenarios (default "
+                         "2000; truncated trees are recorded in the report)")
+    ap.add_argument("--scenario", action="append", default=None,
+                    metavar="NAME",
+                    help="explore only this scenario (repeatable; "
+                         "comma-separated also accepted)")
+    ap.add_argument("--seed-bug", default=None, metavar="NAME",
+                    help="audit with this seeded protocol bug injected "
+                         "(checker self-test; see analysis/proto/seeded.py)")
+    ap.add_argument("--replay", default=None, metavar="SPEC",
+                    help="re-execute one schedule from a finding's "
+                         "<scenario>:<fault-index>:<c0.c1...> spec and "
+                         "print the judged record")
+    ap.add_argument("--obs-log", default=None, metavar="PATH",
+                    help="land the proto_audit event on this telemetry log "
+                         "(default: $BNSGCN_OBS_LOG)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress per-scenario progress lines")
+    args = ap.parse_args(argv)
+
+    from bnsgcn_tpu.analysis.proto import (DEFAULT_MAX_SCHEDULES,
+                                           run_proto_audit, run_replay)
+    if args.replay:
+        try:
+            rec = run_replay(args.replay, seed_bug=args.seed_bug)
+        except ValueError as ex:
+            print(f"graftcheck-proto: {ex}", file=sys.stderr)
+            return 2
+        print(json.dumps(rec, indent=2, sort_keys=True))
+        return 0 if rec["ok"] else 1
+
+    scenarios = None
+    if args.scenario:
+        scenarios = [n.strip() for spec in args.scenario
+                     for n in spec.split(",") if n.strip()]
+    progress = None if args.quiet else (
+        lambda msg: print(msg, file=sys.stderr))
+    try:
+        report = run_proto_audit(
+            root=args.root,
+            max_schedules=args.max_schedules or DEFAULT_MAX_SCHEDULES,
+            scenarios=scenarios, seed_bug=args.seed_bug,
+            obs_log=args.obs_log, progress=progress)
+    except ValueError as ex:        # unknown scenario / seed-bug name
+        print(f"graftcheck-proto: {ex}", file=sys.stderr)
+        return 2
+
+    for f in report["findings"]:
+        print(f"{f['file']}: [{f['rule']}] {f['message']}")
+        hint = RULE_DOCS.get(f["rule"], ("", ""))[1]
+        if hint:
+            print(f"    fix: {hint}")
+
+    if args.json_path == "-":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    elif args.json_path:
+        write_report(report, args.json_path)
+
+    tag = "clean" if report["ok"] else "FAIL"
+    trunc = (f", truncated: {', '.join(report['truncated'])}"
+             if report["truncated"] else "")
+    print(f"graftcheck-proto: {tag} — {report['n_schedules']} schedule(s) "
+          f"across {report['n_scenarios']} scenario(s) in "
+          f"{report['elapsed_s']}s, {len(report['findings'])} finding(s), "
+          f"{len(report['errors'])} explore error(s){trunc}",
+          file=sys.stderr)
+    if report["errors"]:
+        return 2
+    return 1 if report["findings"] else 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "ir":
         return ir_main(argv[1:])
+    if argv and argv[0] == "proto":
+        return proto_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m bnsgcn_tpu.analysis",
         description="graftlint — SPMD-aware static analysis for this repo")

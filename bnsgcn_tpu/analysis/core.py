@@ -137,6 +137,68 @@ RULE_DOCS = {
         "the build/trace path for this lever combination is broken — "
         "reproduce with `python -m bnsgcn_tpu.analysis ir` and fix the "
         "exception before trusting any run that can retune into it"),
+    # -- family 9: lock-order discipline (rules_lockorder.py) --
+    "lock-order-cycle": (
+        "lock-acquisition graph has a cycle: two locks are taken in "
+        "opposite nesting orders (or a non-reentrant lock re-enters "
+        "itself) — a potential deadlock between the threaded subsystems",
+        "pick ONE global order for the locks involved and restructure the "
+        "nested `with` blocks so every code path acquires them in that "
+        "order (or copy the needed state out and release first)"),
+    "lock-held-blocking-call": (
+        "blocking call (thread join, sleep, fsync, socket I/O, "
+        "coordinator RPC) inside a `with <lock>:` block",
+        "move the blocking call outside the lock: snapshot the guarded "
+        "state under the lock, release, then block — a stalled disk or "
+        "peer otherwise wedges every thread contending for that lock"),
+    # -- family 10: protocol model checking (analysis/proto, `proto`
+    #    subcommand). Findings attribute to proto://<scenario>#<hash>
+    #    with a replayable schedule trace in the message. --
+    "proto-agreement": (
+        "two ranks completed the same exchange with different results "
+        "(verdict / decision / checkpoint / restart epoch / broadcast "
+        "payload) under an explored schedule",
+        "the protocol let ranks adopt divergent outcomes for one seq — "
+        "replay the schedule trace with `python -m bnsgcn_tpu.analysis "
+        "proto --replay <spec>` and fix coord.py's publish/confirm "
+        "ordering before trusting any coordinated run"),
+    "proto-split-brain": (
+        "a rank adopted a stale run's namespace/payload across run "
+        "tokens (FileTransport relaunch race)",
+        "the .boot token pin/refuse logic regressed: a peer must reject "
+        "dead same-host tokens and only pin a token after a successful "
+        "get — replay the schedule to reproduce"),
+    "proto-reduce-order": (
+        "agreed decision contradicts the worst-wins state reduction "
+        "(e.g. a diverged rank lost to a preempted one)",
+        "STATE_PRIORITY/_DECISION_OF drifted from the documented order "
+        "ok < preempted < diverged < abort — a preempt checkpoint "
+        "written from NaN state would poison the resume"),
+    "proto-retired-live-key": (
+        "key retirement deleted a message a lagging rank had not yet "
+        "read, inside its legal in-window lag",
+        "PRUNE_HORIZON (or _retire's bookkeeping) regressed: a spent "
+        "exchange's keys must survive the maximum legal peer lag — "
+        "replay the schedule trace to see the put/delete/timeout order"),
+    "proto-exit-code": (
+        "a terminal path ended in an undocumented way (an exception "
+        "outside the CoordTimeout/CoordAbort/DivergenceError/"
+        "PreemptedError -> {77,78,76,75} contract, or a disallowed exit "
+        "for the scenario's fault)",
+        "map the failure onto exactly one documented exit code "
+        "(resilience.py EXIT_* constants) — requeue wrappers triage on "
+        "these codes"),
+    "proto-hang": (
+        "a schedule did not terminate within the modeled deadline "
+        "budget (silent hang: every wait must be deadline-bounded)",
+        "some wait path lacks a deadline (or sleeps past its own): "
+        "bound it with Coordinator._deadline so the worst case is a "
+        "named CoordTimeout, never a stuck rank"),
+    "proto-explore-error": (
+        "a proto scenario crashed the explorer itself (harness error, "
+        "not a protocol verdict)",
+        "reproduce with `python -m bnsgcn_tpu.analysis proto --scenario "
+        "<name>` and fix the exception before trusting the audit"),
     # -- framework --
     "suppression-stale": (
         "graftlint: disable= comment whose line no longer triggers any "
@@ -224,6 +286,10 @@ class Context:
     donated: dict = field(default_factory=dict)       # fn name -> (positions)
     event_kinds: set = field(default_factory=set)     # obs.EVENT_KINDS
     have_event_registry: bool = False
+    lock_edges: list = field(default_factory=list)    # cross-module lock-
+                        # acquisition graph: (held, acquired, relpath, line)
+    lock_kinds: dict = field(default_factory=dict)    # lock name -> Lock/
+                        # RLock/Condition (from threading.* assignments)
 
 
 def parse_module(path: str, root: str) -> Module | None:
@@ -311,10 +377,10 @@ def iter_py_files(paths: list[str], root: str) -> list[str]:
 
 def _rule_modules():
     from bnsgcn_tpu.analysis import (rules_contract, rules_donation,
-                                     rules_hostsync, rules_locks,
-                                     rules_prng, rules_spmd)
+                                     rules_hostsync, rules_lockorder,
+                                     rules_locks, rules_prng, rules_spmd)
     return [rules_spmd, rules_prng, rules_hostsync, rules_donation,
-            rules_locks, rules_contract]
+            rules_locks, rules_lockorder, rules_contract]
 
 
 def resolve_root(root: str | None = None) -> str:

@@ -1,0 +1,228 @@
+"""graftcheck-proto: exhaustive model checking of the coordination protocol.
+
+Third analysis tier. The AST tier (rules_*) proves source-level hazards
+absent and the IR tier (analysis/ir) verifies the compiled programs;
+this tier verifies the DISTRIBUTED PROTOCOL — the agree/broadcast/
+gather_ok/rollback/resume exchanges of `parallel/coord.py` and
+`resilience.py` — by running the real classes under a deterministic
+scheduler (analysis/proto/sim) and enumerating rank interleavings x
+fault schedules (analysis/proto/explore) for every scenario in
+analysis/proto/scenarios.
+
+Checked invariants (rule family 10):
+
+    proto-agreement         no two surviving ranks adopt different
+                            verdicts / restart epochs / payloads for the
+                            same exchange
+    proto-split-brain       no two ranks finish an exchange under
+                            different run tokens (file transport)
+    proto-reduce-order      the state reduction is worst-wins
+                            (diverged > preempted)
+    proto-retired-live-key  key retirement never drops a message a
+                            lagging in-window rank has yet to read
+    proto-exit-code         every terminal path maps onto the documented
+                            exit codes {75, 76, 77, 78}; fault-free
+                            schedules complete
+    proto-hang              bounded liveness: every schedule quiesces
+                            within the virtual-time budget
+
+Entry points: ``run_proto_audit`` / ``run_replay`` (library),
+``python -m bnsgcn_tpu.analysis proto`` (CLI), `tools/lint.sh` gate 3.
+Findings carry a ``proto://<scenario>#<schedule-hash>`` location and a
+minimized replayable schedule spec (``--replay``). The seeded-bug
+fixtures in analysis/proto/seeded.py keep the checker itself honest.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+
+from bnsgcn_tpu.analysis.proto import seeded
+from bnsgcn_tpu.analysis.proto.explore import (explore_fault, judge,
+                                               make_dead_pid, minimize,
+                                               parse_spec, run_schedule,
+                                               schedule_hash, schedule_spec)
+from bnsgcn_tpu.analysis.proto.scenarios import ALL_SCENARIOS
+
+DEFAULT_MAX_SCHEDULES = 2000
+
+
+def _select(scenario_names):
+    if not scenario_names:
+        return list(ALL_SCENARIOS)
+    by_name = {s.name: s for s in ALL_SCENARIOS}
+    unknown = [n for n in scenario_names if n not in by_name]
+    if unknown:
+        raise ValueError(
+            f"unknown scenario(s): {', '.join(unknown)} (have: "
+            f"{', '.join(sorted(by_name))})")
+    return [by_name[n] for n in scenario_names]
+
+
+def run_proto_audit(root: str | None = None,
+                    max_schedules: int = DEFAULT_MAX_SCHEDULES,
+                    scenarios=None, seed_bug: str | None = None,
+                    obs_log: str | None = None, progress=None) -> dict:
+    """Explore every (scenario, fault) schedule tree and judge each run.
+    Returns the JSON-able report (schema mirrors the ir tier; documented
+    in README 'Protocol verification').
+
+    `max_schedules` is the CI budget knob: it is split across scenarios
+    (and their faults), and a tree bigger than its slice is truncated
+    WITH the truncation recorded in the report — never silently."""
+    from bnsgcn_tpu.analysis.core import Finding, resolve_root
+
+    root = resolve_root(root)
+    t0 = time.time()
+    todo = _select(scenarios)
+    per_scenario = max(96, max_schedules // max(len(todo), 1))
+
+    findings: list = []
+    rows: list = []
+    errors: list = []
+    truncated: list = []
+    n_schedules = 0
+    workspace = tempfile.mkdtemp(prefix="graftcheck-proto-")
+    os.makedirs(os.path.join(workspace, "ck"), exist_ok=True)
+    dead_pid = (make_dead_pid()
+                if any(s.kind == "file" for s in todo) else None)
+    try:
+        with seeded.apply(seed_bug):
+            for si, scenario in enumerate(todo):
+                faults = scenario.faults()
+                budget = max(24, per_scenario // len(faults))
+                # rule -> [count, fault_idx, choices, fault_name, detail]
+                hits: dict[str, list] = {}
+                runs = 0
+                exhausted = True
+
+                def on_violation(fault_idx, rec, violations,
+                                 hits=hits, faults=faults):
+                    seen = set()    # count violating SCHEDULES per rule,
+                    for v in violations:        # not individual breaches
+                        if v.rule in seen:
+                            continue
+                        seen.add(v.rule)
+                        cur = hits.get(v.rule)
+                        if cur is None:
+                            hits[v.rule] = [1, fault_idx, list(rec.choices),
+                                            faults[fault_idx][0], v.detail]
+                        else:
+                            cur[0] += 1
+
+                try:
+                    for fi in range(len(faults)):
+                        if progress is not None:
+                            progress(f"[proto] {si + 1}/{len(todo)} "
+                                     f"{scenario.name} [{faults[fi][0]}]")
+                        n, done = explore_fault(scenario, fi, budget,
+                                                workspace, dead_pid,
+                                                on_violation)
+                        runs += n
+                        exhausted = exhausted and done
+                    for rule in sorted(hits):
+                        count, fi, choices, fname, detail = hits[rule]
+                        small = minimize(scenario, fi, choices, rule,
+                                         workspace, dead_pid)
+                        spec = schedule_spec(scenario.name, fi, small)
+                        seed_note = (f" [seed-bug {seed_bug}]"
+                                     if seed_bug else "")
+                        findings.append(Finding(
+                            file=(f"proto://{scenario.name}"
+                                  f"#{schedule_hash(scenario.name, fi, small)}"),
+                            line=0, col=0, rule=rule,
+                            message=(
+                                f"{detail} [fault {fname}; {count} of "
+                                f"{runs} schedule(s){seed_note}; replay: "
+                                f"python -m bnsgcn_tpu.analysis proto "
+                                f"--replay '{spec}'"
+                                + (f" --seed-bug {seed_bug}"
+                                   if seed_bug else "") + "]")))
+                except Exception as ex:     # harness bug — attribute, go on
+                    errors.append(
+                        f"{scenario.name}: {type(ex).__name__}: {ex}")
+                    findings.append(Finding(
+                        file=f"proto://{scenario.name}", line=0, col=0,
+                        rule="proto-explore-error",
+                        message=f"scenario failed to explore: "
+                                f"{type(ex).__name__}: {ex}"))
+                    exhausted = False
+                n_schedules += runs
+                if not exhausted:
+                    truncated.append(scenario.name)
+                rows.append({
+                    "name": scenario.name, "world": scenario.world,
+                    "kind": scenario.kind, "n_faults": len(faults),
+                    "schedules": runs, "exhausted": exhausted,
+                    "findings": sum(c for c, *_ in hits.values()),
+                })
+    finally:
+        shutil.rmtree(workspace, ignore_errors=True)
+
+    counts: dict = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    report = {
+        "graftcheck_proto": 1,
+        "root": root,
+        "seed_bug": seed_bug,
+        "n_scenarios": len(todo),
+        "n_schedules": n_schedules,
+        "elapsed_s": round(time.time() - t0, 2),
+        "ok": not findings,
+        "truncated": truncated,
+        "scenarios": rows,
+        "findings": [f.as_dict() for f in findings],
+        "counts": counts,
+        "errors": errors,
+    }
+    _emit_event(report, obs_log)
+    return report
+
+
+def run_replay(spec: str, seed_bug: str | None = None) -> dict:
+    """Re-execute one schedule from its `<scenario>:<fault-index>:
+    <c0.c1...>` spec and re-judge it — the debugging end of a finding."""
+    scenario, fault_idx, choices = parse_spec(spec)
+    workspace = tempfile.mkdtemp(prefix="graftcheck-proto-replay-")
+    os.makedirs(os.path.join(workspace, "ck"), exist_ok=True)
+    dead_pid = make_dead_pid() if scenario.kind == "file" else None
+    try:
+        with seeded.apply(seed_bug):
+            rec = run_schedule(scenario, fault_idx, choices, workspace,
+                               dead_pid)
+            violations = judge(scenario, rec)
+    finally:
+        shutil.rmtree(workspace, ignore_errors=True)
+    return {
+        "spec": spec,
+        "seed_bug": seed_bug,
+        "scenario": scenario.name,
+        "fault": rec.fault_name,
+        "hung": rec.hung,
+        "outcomes": {str(r): list(o) for r, o in sorted(rec.outcomes.items())},
+        "trail": list(rec.choices),
+        "trace": [[t, r, op, key] for (t, r, op, key) in rec.trace],
+        "violations": [{"rule": v.rule, "detail": v.detail}
+                       for v in violations],
+        "ok": not violations,
+    }
+
+
+def _emit_event(report: dict, obs_log: str | None):
+    """Land a `proto_audit` event on the telemetry bus when a log is
+    configured (--obs-log or $BNSGCN_OBS_LOG) — a pod run's preflight
+    verdict then sits next to the run it gated."""
+    path = obs_log or os.environ.get("BNSGCN_OBS_LOG", "")
+    if not path:
+        return
+    from bnsgcn_tpu.obs import EventLog
+    EventLog(path).emit(
+        "proto_audit", ok=report["ok"],
+        n_scenarios=report["n_scenarios"],
+        n_schedules=report["n_schedules"],
+        n_findings=len(report["findings"]), counts=report["counts"],
+        elapsed_s=report["elapsed_s"], errors=len(report["errors"]))

@@ -1,0 +1,263 @@
+"""Schedule exploration and invariant judging for graftcheck-proto.
+
+One *schedule* = one deterministic execution of a scenario under a
+prescribed choice prefix (which runnable rank gets the baton at each
+point where more than one could run) and one fault entry. The explorer
+enumerates the schedule tree of every (scenario, fault) pair in DFS
+order — run with a prefix, read back the recorded `trail`, branch the
+deepest not-yet-exhausted choice point — up to the scheduler's branch
+bound and a per-fault run budget (truncation is reported, never silent).
+
+Every execution is judged against the global invariants (agreement, the
+documented exit-code map, no retired live key, bounded liveness) plus
+the scenario's own expectations. A violating schedule is minimized by
+greedy prefix shortening (the shortest prescribed prefix that still
+reproduces the same rule, everything beyond it default-scheduled) and
+reported as a replayable `<scenario>:<fault-index>:<c0.c1...>` spec.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import tempfile
+
+from bnsgcn_tpu.analysis.proto.scenarios import (ALL_SCENARIOS, TIMEOUT_S,
+                                                 RunContext, Scenario,
+                                                 Violation)
+from bnsgcn_tpu.analysis.proto.sim import Scheduler
+from bnsgcn_tpu.parallel.coord import CoordAbort, CoordError, CoordTimeout
+
+_KEY_RE = re.compile(r"key '([^']+)'")
+_MINIMIZE_CAP = 40      # max replays spent shrinking one violating schedule
+
+
+class RunRecord:
+    """Everything the judge needs from one executed schedule."""
+
+    def __init__(self, scenario_name, fault_name, fault, outcomes, hung,
+                 trace, reads, choices, options):
+        self.scenario_name = scenario_name
+        self.fault_name = fault_name
+        self.fault = fault
+        self.outcomes = outcomes    # rank -> ("done", json) | ("exit", code,
+                                    # msg) | ("error", msg) | ("crashed",)
+                                    # | ("aborted",)  [aborted = hung run]
+        self.hung = hung
+        self.trace = trace
+        self.reads = reads
+        self.choices = choices      # the full recorded trail
+        self.options = options      # n_options per trail entry
+
+
+def _fmt_outcome(o) -> str:
+    if o[0] == "done":
+        return "done"
+    if o[0] == "exit":
+        return f"exit {o[1]}"
+    if o[0] == "error":
+        return f"undocumented error ({o[1]})"
+    return o[0]
+
+
+def _wrap_body(scenario: Scenario, ctx: RunContext, rank: int):
+    """Run the rank body and map its termination onto the documented
+    exit-code contract — the same mapping main.py applies."""
+    from bnsgcn_tpu.resilience import DivergenceError, PreemptedError
+
+    def fn():
+        try:
+            return ("done", json.dumps(scenario.body(ctx, rank),
+                                       sort_keys=True, default=repr))
+        except CoordTimeout as ex:
+            return ("exit", 77, str(ex))
+        except CoordAbort as ex:
+            return ("exit", 78, str(ex))
+        except DivergenceError:
+            return ("exit", 76, "")
+        except PreemptedError:
+            return ("exit", 75, "")
+        except CoordError as ex:
+            # the base class is NOT a documented terminal state
+            return ("error", f"{type(ex).__name__}: {ex}")
+        except Exception as ex:     # noqa: BLE001 — that's the invariant
+            return ("error", f"{type(ex).__name__}: {ex}")
+    return fn
+
+
+def run_schedule(scenario: Scenario, fault_idx: int, prescribed,
+                 workspace: str, dead_pid) -> RunRecord:
+    fault_name, fault = scenario.faults()[fault_idx]
+    sched = Scheduler(prescribed=prescribed, time_budget=40 * TIMEOUT_S)
+    file_dir = None
+    if scenario.kind == "file":
+        file_dir = tempfile.mkdtemp(prefix=f"{scenario.name}-",
+                                    dir=workspace)
+    ctx = RunContext(sched, fault, os.path.join(workspace, "ck"),
+                     file_dir=file_dir, dead_pid=dead_pid)
+    scenario.setup(ctx)
+    for r in range(scenario.world):
+        sched.spawn(r, _wrap_body(scenario, ctx, r))
+    sched.run()
+    if file_dir is not None:
+        shutil.rmtree(file_dir, ignore_errors=True)
+    outcomes = {}
+    for a in sched.actors:
+        if a.state in ("done", "failed"):
+            outcomes[a.rank] = a.outcome
+        elif a.state == "crashed":
+            outcomes[a.rank] = ("crashed",)
+        else:
+            outcomes[a.rank] = ("aborted",)
+    return RunRecord(scenario.name, fault_name, fault, outcomes, sched.hung,
+                     ctx.net.trace, ctx.net.reads,
+                     [c for c, _ in sched.trail],
+                     [n for _, n in sched.trail])
+
+
+def judge(scenario: Scenario, rec: RunRecord) -> list[Violation]:
+    """The global invariants; scenario.check() adds its own on top."""
+    v: list[Violation] = []
+    if rec.hung:
+        stuck = sorted(r for r, o in rec.outcomes.items()
+                       if o[0] == "aborted")
+        v.append(Violation(
+            "proto-hang",
+            f"schedule never quiesced within the virtual-time budget — "
+            f"rank(s) {stuck} still blocked (silent hang, no exit code)"))
+        return v    # a hung run's other outcomes are meaningless
+
+    for r, o in sorted(rec.outcomes.items()):
+        if o[0] == "error":
+            v.append(Violation(
+                "proto-exit-code",
+                f"rank {r} terminated outside the documented exit-code "
+                f"map {{75,76,77,78}}: {o[1]}"))
+
+    done = {r: o[1] for r, o in rec.outcomes.items() if o[0] == "done"}
+    if len(set(done.values())) > 1:
+        v.append(Violation(
+            "proto-agreement",
+            "ranks adopted different results for the same exchange: "
+            + "; ".join(f"rank {r}: {val[:120]}"
+                        for r, val in sorted(done.items()))))
+
+    # a 77 whose missing key was put AND retired without this rank ever
+    # reading it: the prune horizon dropped a live in-window message
+    ops = {(op, key) for (_, _, op, key) in rec.trace}
+    for r, o in sorted(rec.outcomes.items()):
+        if o[0] == "exit" and o[1] == 77:
+            m = _KEY_RE.search(o[2] or "")
+            if m is not None:
+                k = m.group(1)
+                if (("put", k) in ops and ("del", k) in ops
+                        and (r, k) not in rec.reads):
+                    v.append(Violation(
+                        "proto-retired-live-key",
+                        f"key {k!r} was retired before rank {r} read it "
+                        f"(rank {r} then timed out waiting on it)"))
+
+    if rec.fault is None:
+        exp = scenario.expect_nominal
+        for r, o in sorted(rec.outcomes.items()):
+            if exp == "done" and o[0] != "done":
+                v.append(Violation(
+                    "proto-exit-code",
+                    f"fault-free schedule: rank {r} ended with "
+                    f"{_fmt_outcome(o)} instead of completing"))
+            elif isinstance(exp, int) and (o[0] != "exit" or o[1] != exp):
+                v.append(Violation(
+                    "proto-exit-code",
+                    f"fault-free schedule: rank {r} ended with "
+                    f"{_fmt_outcome(o)} instead of the agreed exit {exp}"))
+    return v + scenario.check(rec)
+
+
+# ----------------------------------------------------------------------------
+# DFS enumeration + minimization
+# ----------------------------------------------------------------------------
+
+def _next_prefix(choices, options):
+    """The DFS successor of this run's trail: branch the deepest choice
+    point that still has an untried sibling; None when exhausted."""
+    for i in range(len(choices) - 1, -1, -1):
+        if choices[i] + 1 < options[i]:
+            return list(choices[:i]) + [choices[i] + 1]
+    return None
+
+
+def explore_fault(scenario, fault_idx, budget, workspace, dead_pid,
+                  on_violation) -> tuple[int, bool]:
+    """Enumerate one (scenario, fault) schedule tree up to `budget` runs.
+    Returns (runs, exhausted)."""
+    prefix: list[int] = []
+    n = 0
+    while n < budget:
+        rec = run_schedule(scenario, fault_idx, prefix, workspace, dead_pid)
+        n += 1
+        violations = judge(scenario, rec)
+        if violations:
+            on_violation(fault_idx, rec, violations)
+        nxt = _next_prefix(rec.choices, rec.options)
+        if nxt is None:
+            return n, True
+        prefix = nxt
+    return n, False
+
+
+def minimize(scenario, fault_idx, choices, rule, workspace,
+             dead_pid) -> list[int]:
+    """Shortest prescribed prefix of `choices` that still reproduces a
+    violation of `rule` (defaults beyond the prefix)."""
+    if len(choices) > _MINIMIZE_CAP:
+        return list(choices)
+    for k in range(len(choices) + 1):
+        rec = run_schedule(scenario, fault_idx, choices[:k], workspace,
+                           dead_pid)
+        if any(v.rule == rule for v in judge(scenario, rec)):
+            return list(choices[:k])
+    return list(choices)        # defensive: full trail always reproduces
+
+
+def schedule_spec(scenario_name: str, fault_idx: int, choices) -> str:
+    return (f"{scenario_name}:{fault_idx}:"
+            + (".".join(map(str, choices)) or "-"))
+
+
+def schedule_hash(scenario_name: str, fault_idx: int, choices) -> str:
+    return hashlib.sha1(
+        schedule_spec(scenario_name, fault_idx, choices).encode()
+    ).hexdigest()[:8]
+
+
+def parse_spec(spec: str) -> tuple[Scenario, int, list[int]]:
+    try:
+        name, fi, tail = spec.split(":")
+        scenario = {s.name: s for s in ALL_SCENARIOS}[name]
+        choices = ([] if tail in ("", "-")
+                   else [int(x) for x in tail.split(".")])
+        if not 0 <= int(fi) < len(scenario.faults()):
+            raise ValueError(f"fault index {fi} out of range")
+        return scenario, int(fi), choices
+    except (ValueError, KeyError) as ex:
+        raise ValueError(
+            f"bad replay spec {spec!r} (want <scenario>:<fault-index>:"
+            f"<c0.c1...> with '-' for the default schedule): {ex}") from ex
+
+
+def make_dead_pid() -> int:
+    """A pid that verifiably belonged to a dead same-host process (spawned
+    child, exited and reaped) — the stale-boot-token scenarios' bait.
+    subprocess, not os.fork(): the audit may run inside a test process
+    that already imported jax, and forking a multithreaded process can
+    deadlock the child."""
+    import subprocess
+    import sys
+    p = subprocess.Popen([sys.executable, "-c", "pass"],
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL)
+    p.wait()
+    return p.pid

@@ -1,0 +1,476 @@
+"""The protocol scenarios the model checker explores.
+
+Each scenario is one lockstep exchange pattern from the production
+callers of `parallel/coord.py` (run.py's step boundary, resilience.py's
+rollback, the resume choice), expressed as per-rank bodies that call the
+REAL `Coordinator` / `ResilienceManager` methods. A scenario also names
+its fault vocabulary — crash points, message delays, torn checkpoint
+acks, stale boot tokens — and its own expectations beyond the global
+invariants (documented in the README "Protocol verification" table).
+
+A fault entry of `None` is the fault-free run: there the judge demands
+full completion (`expect_nominal`) on EVERY interleaving — that is the
+bounded-liveness half of the audit. Under a fault, any documented exit
+{75,76,77,78} (or the crash itself) is acceptable unless the scenario
+says otherwise; what is never acceptable is a hang, an undocumented
+exception, or two surviving ranks adopting different results.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from types import SimpleNamespace
+
+from bnsgcn_tpu.analysis.proto.sim import (Scheduler, SimNet, SimTransport,
+                                           make_file_transport)
+from bnsgcn_tpu.parallel.coord import Coordinator, _host
+
+# Small per-exchange bound: virtual seconds are free, but the poll/backoff
+# loops still execute — a short window keeps the op count per schedule low.
+TIMEOUT_S = 0.2
+
+
+def _silent(*args, **kwargs):
+    pass
+
+
+class Violation:
+    """One invariant breach observed on one schedule."""
+
+    def __init__(self, rule: str, detail: str):
+        self.rule = rule
+        self.detail = detail
+
+
+class RunContext:
+    """Everything one simulated run shares across its rank bodies."""
+
+    def __init__(self, sched: Scheduler, fault, ckpt_dir: str,
+                 file_dir: str | None = None, dead_pid: int | None = None):
+        self.sched = sched
+        self.net = SimNet()
+        self.timeout_s = TIMEOUT_S
+        self.fault = fault
+        self.ckpt_dir = ckpt_dir
+        self.file_dir = file_dir
+        self.dead_pid = dead_pid
+        if fault:
+            for spec in fault.get("crash", ()):
+                sched.crashes.add(tuple(spec))
+            for spec in fault.get("delay", ()):
+                self.net.delays.append(list(spec))
+        # a rank-0 process crash takes the in-memory KV server with it
+        sched.on_crash.append(
+            lambda rank: rank == 0 and setattr(self.net, "server_up", False))
+
+    def coord(self, rank: int, world: int) -> Coordinator:
+        c = Coordinator(rank, world,
+                        SimTransport(self.sched, self.net, rank),
+                        self.timeout_s, log=_silent)
+        c._clock = self.sched.clock
+        c._sleep = self.sched.sleep
+        return c
+
+    def file_coord(self, rank: int, world: int) -> Coordinator:
+        t = make_file_transport(self.sched, self.file_dir, rank)
+        c = Coordinator(rank, world, t, self.timeout_s, log=_silent)
+        c._clock = self.sched.clock
+        c._sleep = self.sched.sleep
+        return c
+
+    def rm(self, coord: Coordinator, resil_retries: int = 2,
+           have_ckpt: bool = True):
+        """A real ResilienceManager wired to the virtual clock: signals
+        and watchdog are constructed but never installed/started, and the
+        checkpoint seams return deterministic fake payloads — the decide/
+        reduce/ack logic under test is the production code."""
+        from bnsgcn_tpu.resilience import ResilienceManager
+        cfg = SimpleNamespace(inject="", resil_retries=resil_retries,
+                              ckpt_path=self.ckpt_dir)
+        m = ResilienceManager(cfg, log=_silent, coord=coord, obs=None)
+        m.backoff_base = 0.1
+        m._sleep = self.sched.sleep
+        payload = {"epoch": 5, "blob": "x"}
+        if have_ckpt:
+            m._find_ckpt = (lambda cfg, log=None, before_epoch=None:
+                            (os.path.join("ck", "ckpt_E5.ckpt"),
+                             dict(payload)))
+        else:
+            m._find_ckpt = lambda cfg, log=None, before_epoch=None: None
+        m._load_ckpt = lambda path: dict(payload)
+        m._restore_into = lambda p, a, b, c: (p["epoch"],) * 3
+        return m
+
+
+class Scenario:
+    name = ""
+    world = 2
+    kind = "net"                # "file" runs need a fresh directory
+    expect_nominal = "done"     # or an int exit code all ranks must reach
+
+    def faults(self):
+        return [("nominal", None)]
+
+    def setup(self, ctx: RunContext):
+        pass
+
+    def body(self, ctx: RunContext, rank: int):
+        raise NotImplementedError
+
+    def check(self, rec) -> list:
+        """Scenario-specific violations; `rec` is explore.RunRecord."""
+        return []
+
+
+def _done_values(rec) -> dict[int, dict]:
+    out = {}
+    for r, o in rec.outcomes.items():
+        if o[0] == "done":
+            try:
+                out[r] = json.loads(o[1])
+            except ValueError:
+                pass
+    return out
+
+
+def _expect_decision(rec, expected: str, why: str) -> list:
+    out = []
+    for r, val in sorted(_done_values(rec).items()):
+        d = val.get("decision") if isinstance(val, dict) else None
+        if isinstance(d, dict):
+            d = d.get("decision")
+        if d != expected:
+            out.append(Violation(
+                "proto-reduce-order",
+                f"rank {r} adopted decision {d!r} where the canonical "
+                f"reduction requires {expected!r} ({why})"))
+    return out
+
+
+# ----------------------------------------------------------------------------
+# tcp-model scenarios
+# ----------------------------------------------------------------------------
+
+class AgreeOk(Scenario):
+    """Two healthy step boundaries, then the completion barrier and the
+    rank-0 server teardown — the happy path every epoch takes."""
+
+    name = "agree-ok"
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # put #1 is the step heartbeat, #2 the verdict
+            ("crash-r1-before-verdict", {"crash": [(1, "put", 2, "before")]}),
+            ("crash-r1-after-verdict", {"crash": [(1, "put", 2, "after")]}),
+            ("crash-r0-mid-gather", {"crash": [(0, "get", 2, "before")]}),
+            ("delay-decision", {"delay": [("d/", 0.15, 1)]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.coord(rank, self.world)
+        d1 = c.agree(1, "ok")
+        d2 = c.agree(2, "ok")
+        c.finish()
+        if rank == 0:
+            c.close()
+        return [d1, d2]
+
+
+class AgreePreempt(Scenario):
+    """One rank got SIGTERM: the agreed verdict must reach every rank
+    BEFORE rank 0's orderly teardown — the confirm phase's whole job."""
+
+    name = "agree-preempt"
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # rank 1 puts: #1 heartbeat, #2 verdict, #3 the confirm ack
+            ("crash-r1-before-confirm", {"crash": [(1, "put", 3, "before")]}),
+            ("delay-verdict", {"delay": [("v/", 0.1, 1)]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.coord(rank, self.world)
+        d = c.agree(1, "preempted" if rank == 1 else "ok")
+        if rank == 0:
+            c.close()       # the dying rank 0: exit 75 right after agree
+        return d
+
+    def check(self, rec):
+        return _expect_decision(rec, "preempt",
+                                "a rank reported 'preempted'")
+
+
+class AgreeWorstWins(Scenario):
+    """preempted and diverged in the same exchange: the reduction must
+    pick rollback (diverged outranks preempted — a preempt checkpoint
+    written from NaN state would poison the resume)."""
+
+    name = "agree-worst-wins"
+    world = 3
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            ("delay-verdict-r2", {"delay": [("v/0/2", 0.1, 1)]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.coord(rank, self.world)
+        m = ctx.rm(c)
+        d = m.agree_step(1, {0: "ok", 1: "preempted", 2: "diverged"}[rank])
+        return {"decision": d.get("decision"), "restart": d.get("restart"),
+                "nonce": d.get("nonce")}
+
+    def check(self, rec):
+        v = _expect_decision(rec, "rollback",
+                             "diverged outranks preempted")
+        for r, val in sorted(_done_values(rec).items()):
+            if val.get("decision") == "rollback" and val.get("restart") != 6:
+                v.append(Violation(
+                    "proto-agreement",
+                    f"rank {r} adopted restart epoch {val.get('restart')} "
+                    f"instead of 6 (checkpoint epoch 5 + 1)"))
+        return v
+
+
+class RollbackAck(Scenario):
+    """A full coordinated rollback: agree -> plan -> per-rank restore ->
+    gathered ack. A torn restore on one rank must turn into the agreed
+    exit 78 on EVERY rank, never a silent epoch desync."""
+
+    name = "rollback-ack"
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            ("torn-ckpt-ack", {"torn_rank": 1}),
+            # rank 1 puts: #1 heartbeat, #2 verdict, #3 the rollback ack
+            ("crash-r1-before-ack", {"crash": [(1, "put", 3, "before")]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.coord(rank, self.world)
+        m = ctx.rm(c)
+        if ctx.fault and ctx.fault.get("torn_rank") == rank:
+            def torn(payload, p, o, s):
+                from bnsgcn_tpu import checkpoint as ckpt
+                raise ckpt.CheckpointCorrupt("torn checkpoint (injected)")
+            m._restore_into = torn
+        d = m.agree_step(1, "diverged" if rank == 1 else "ok")
+        if d["decision"] == "abort":
+            m.raise_abort(d)
+        out = m.coord_restore(d, "p", "o", "s")
+        return {"restart": d["restart"], "source": d["source"],
+                "restored": list(out)}
+
+    def check(self, rec):
+        if rec.fault_name != "torn-ckpt-ack":
+            return []
+        v = []
+        for r, o in sorted(rec.outcomes.items()):
+            if o[0] == "crashed" or (o[0] == "exit" and o[1] == 78):
+                continue
+            v.append(Violation(
+                "proto-exit-code",
+                f"rank {r} ended {o[:2]} under a torn checkpoint ack — "
+                f"the agreed abort must exit 78 on every rank"))
+        return v
+
+
+class RollbackExhausted(Scenario):
+    """No retries left and no checkpoint to restore: every rank must
+    raise the SAME DivergenceError and exit 76 — never a mix of codes."""
+
+    name = "rollback-exhausted"
+    expect_nominal = 76
+
+    def body(self, ctx, rank):
+        c = ctx.coord(rank, self.world)
+        m = ctx.rm(c, resil_retries=0, have_ckpt=False)
+        d = m.agree_step(1, "diverged" if rank == 1 else "ok")
+        if d["decision"] == "abort":
+            m.raise_abort(d)
+        return d
+
+
+class SlowDecide(Scenario):
+    """decide_fn does real checkpoint I/O past the gather deadline (1.5x
+    the per-exchange bound): the peers' doubled decision window must
+    absorb it — a healthy large-scale rollback is not a 77."""
+
+    name = "slow-decide"
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            ("delay-decision", {"delay": [("d/", 0.1, 1)]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.coord(rank, self.world)
+        decide = None
+        if rank == 0:
+            def decide(name, states):
+                ctx.sched.sleep(1.5 * ctx.timeout_s)
+                return {"decision": "ok", "via": "decide_fn"}
+        return c.agree(1, "ok", decide)
+
+
+class BroadcastResume(Scenario):
+    """The resume choice: rank 0 walks the checkpoint chain (slow), then
+    broadcasts, then all ranks ack the restore. Peers must wait through
+    the doubled window, and the gathered ack must agree."""
+
+    name = "broadcast-resume"
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # peers put nothing before the ack, so put #1 IS the ack
+            ("crash-r1-before-ack", {"crash": [(1, "put", 1, "before")]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.coord(rank, self.world)
+        if rank == 0:
+            ctx.sched.sleep(1.2 * ctx.timeout_s)
+            payload = c.broadcast("resume", {"epoch": 7, "nonce": 3})
+        else:
+            payload = c.broadcast("resume")
+        ok, fails = c.gather_ok("resume", True)
+        return {"payload": payload, "ok": ok,
+                "fails": {str(r): d for r, d in fails.items()}}
+
+
+class CrashVerdict(Scenario):
+    """A rank dies around its verdict put: the survivor must reach a
+    documented exit (or finish cleanly when the verdict landed) within
+    the bound — never hang waiting for a ghost."""
+
+    name = "crash-verdict"
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            ("crash-r1-before-verdict", {"crash": [(1, "put", 2, "before")]}),
+            ("crash-r1-after-verdict", {"crash": [(1, "put", 2, "after")]}),
+            ("crash-r1-before-heartbeat", {"crash": [(1, "put", 1, "before")]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.coord(rank, self.world)
+        d = c.agree(1, "ok")
+        c.finish()
+        if rank == 0:
+            c.close()
+        return d
+
+
+class RetirementLag(Scenario):
+    """Rank 0 sprints four consecutive broadcasts ahead (it returns
+    without waiting for peers) then re-syncs on an agree: the prune
+    horizon must keep every key a lagging peer has yet to read."""
+
+    name = "retirement-lag"
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            ("delay-first-bcast", {"delay": [("b/cfg/0", 0.1, 1)]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.coord(rank, self.world)
+        outs = []
+        for i in range(4):
+            outs.append(c.broadcast("cfg", {"i": i} if rank == 0 else None))
+        d = c.agree(1, "ok")
+        return {"bcasts": outs, "decision": d}
+
+
+# ----------------------------------------------------------------------------
+# file-transport scenarios (the REAL FileTransport against a throwaway dir)
+# ----------------------------------------------------------------------------
+
+class FileBootStale(Scenario):
+    """A previous run's `.boot` (same host, dead pid) and a poisoned
+    decision under its namespace are still on disk when the relaunch
+    starts: a peer racing ahead of rank 0 must reject the dead token —
+    adopting it would replay the stale decision (split-brain)."""
+
+    name = "file-boot-stale"
+    kind = "file"
+
+    def setup(self, ctx):
+        tok = f"{_host()}:{ctx.dead_pid:x}-0"
+        with open(os.path.join(ctx.file_dir, ".boot"), "w") as f:
+            f.write(tok)
+        with open(os.path.join(ctx.file_dir, f"{tok}@d@0"), "w") as f:
+            f.write(json.dumps({"decision": "preempt", "stale": True}))
+
+    def body(self, ctx, rank):
+        c = ctx.file_coord(rank, self.world)
+        d = c.agree(1, "ok")
+        return {"decision": d, "token": c.transport._token}
+
+    def check(self, rec):
+        v = []
+        vals = _done_values(rec)
+        for r, val in sorted(vals.items()):
+            if val.get("decision", {}).get("stale"):
+                v.append(Violation(
+                    "proto-split-brain",
+                    f"rank {r} adopted the dead run's stale decision — "
+                    f"the same-host pid probe failed to retire the token"))
+        toks = {json.dumps(val.get("token")) for val in vals.values()}
+        if len(toks) > 1:
+            v.append(Violation(
+                "proto-split-brain",
+                f"ranks finished under different run tokens: "
+                f"{sorted(toks)}"))
+        return v
+
+
+class FileRelaunch(Scenario):
+    """Duplicate relaunch: the OLD rank 0 is still dying (its pid is
+    alive, so the probe trusts its token) while the new rank 0 purges and
+    re-mints. A peer that provisionally adopted the old token must unpin
+    on its first miss and converge to the fresh namespace — the pin is
+    only earned by a successful get."""
+
+    name = "file-relaunch"
+    kind = "file"
+
+    def setup(self, ctx):
+        # our own pid: same host, provably alive — the dying old rank 0
+        with open(os.path.join(ctx.file_dir, ".boot"), "w") as f:
+            f.write(f"{_host()}:{os.getpid():x}-dead")
+
+    def body(self, ctx, rank):
+        c = ctx.file_coord(rank, self.world)
+        if rank == 0:
+            payload = c.broadcast("resume", {"epoch": 7, "nonce": 3})
+        else:
+            payload = c.broadcast("resume")
+        return {"payload": payload, "token": c.transport._token}
+
+    def check(self, rec):
+        vals = _done_values(rec)
+        toks = {json.dumps(val.get("token")) for val in vals.values()}
+        if len(toks) > 1:
+            return [Violation(
+                "proto-split-brain",
+                f"ranks finished under different run tokens: "
+                f"{sorted(toks)}")]
+        return []
+
+
+ALL_SCENARIOS: tuple[Scenario, ...] = (
+    AgreeOk(), AgreePreempt(), AgreeWorstWins(), RollbackAck(),
+    RollbackExhausted(), SlowDecide(), BroadcastResume(), CrashVerdict(),
+    RetirementLag(), FileBootStale(), FileRelaunch(),
+)

@@ -1,0 +1,120 @@
+"""Seeded protocol bugs — the checker's own regression fixtures.
+
+Each entry reverts (or breaks) one deliberate design decision of the
+coordination protocol by monkeypatching the REAL class under test for
+the duration of one audit. The test suite runs the checker once per
+seed and asserts the documented invariant catches it with a replayable
+trace; if a future refactor quietly re-introduces one of these, the
+clean-at-HEAD gate goes red the same way.
+
+    confirm-removed       agree() skips the terminal confirm barrier:
+                          rank 0 may tear the server down before a slow
+                          peer fetched the verdict  -> proto-exit-code
+                          (a healthy exchange dies 77)
+    ack-window-dropped    peers stop doubling their wait for rank-0
+                          work (decision fetch, broadcast payload):
+                          a slow decide_fn now overruns the window
+                          -> proto-exit-code on slow-decide
+    retire-horizon-1      PRUNE_HORIZON drops to 1: a rank sprinting
+                          ahead retires keys a lagging peer has not
+                          read yet -> proto-retired-live-key
+    pin-before-get        FileTransport pins the boot token as soon as
+                          it is READ rather than on the first
+                          successful get: a peer that adopted a dying
+                          run's token can never converge to the fresh
+                          namespace -> proto-exit-code on file-relaunch
+    reduce-order-flipped  preempted outranks diverged in the state
+                          reduction: a divergence masked by a preempt
+                          resumes from poisoned state
+                          -> proto-reduce-order on agree-worst-wins
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from bnsgcn_tpu.parallel import coord as _coord
+from bnsgcn_tpu.parallel.coord import Coordinator, FileTransport
+
+
+@contextmanager
+def _confirm_removed():
+    orig = Coordinator._confirm
+    Coordinator._confirm = lambda self, seq, deadline: None
+    try:
+        yield
+    finally:
+        Coordinator._confirm = orig
+
+
+@contextmanager
+def _ack_window_dropped():
+    orig = Coordinator._deadline
+    # every deadline collapses to the base per-exchange bound: the 2x
+    # windows peers grant rank-0 work are gone
+    Coordinator._deadline = lambda self, timeout_s=None: orig(self)
+    try:
+        yield
+    finally:
+        Coordinator._deadline = orig
+
+
+@contextmanager
+def _retire_horizon_1():
+    orig = Coordinator.PRUNE_HORIZON
+    Coordinator.PRUNE_HORIZON = 1
+    try:
+        yield
+    finally:
+        Coordinator.PRUNE_HORIZON = orig
+
+
+@contextmanager
+def _pin_before_get():
+    orig = FileTransport._ns
+
+    def eager_pin(self, deadline):
+        tok = orig(self, deadline)
+        self._pinned = True     # pin on READ, not on first successful get
+        return tok
+
+    FileTransport._ns = eager_pin
+    try:
+        yield
+    finally:
+        FileTransport._ns = orig
+
+
+@contextmanager
+def _reduce_order_flipped():
+    pr = _coord.STATE_PRIORITY
+    saved = dict(pr)
+    pr["preempted"], pr["diverged"] = pr["diverged"], pr["preempted"]
+    try:
+        yield
+    finally:
+        pr.clear()
+        pr.update(saved)
+
+
+SEEDED_BUGS = {
+    "confirm-removed": _confirm_removed,
+    "ack-window-dropped": _ack_window_dropped,
+    "retire-horizon-1": _retire_horizon_1,
+    "pin-before-get": _pin_before_get,
+    "reduce-order-flipped": _reduce_order_flipped,
+}
+
+
+@contextmanager
+def apply(name: str | None):
+    """Context for one audit: the named seeded bug, or a no-op."""
+    if name is None:
+        yield
+        return
+    if name not in SEEDED_BUGS:
+        raise ValueError(
+            f"unknown seeded bug {name!r} (have: "
+            f"{', '.join(sorted(SEEDED_BUGS))})")
+    with SEEDED_BUGS[name]():
+        yield

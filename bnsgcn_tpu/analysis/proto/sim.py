@@ -1,0 +1,364 @@
+"""Deterministic-schedule execution of the REAL coordinator code.
+
+The checker never re-models the protocol: each rank's scenario body calls
+the actual `Coordinator` / `ResilienceManager` methods, and the only
+substitutions are (a) a `SimTransport` implementing the production
+transport interface (`put/try_get/delete/dump/close`) against an
+in-memory store, and (b) the `_clock`/`_sleep` seams those classes
+already route every wait through. Under the seams, time is VIRTUAL: it
+advances only when every rank is blocked in a sleep, so a 120 s
+production deadline costs microseconds to explore and a schedule that
+cannot terminate is detected, not waited out.
+
+Scheduling model (Coyote-style): each rank is a thread, but exactly one
+runs at any moment — control passes scheduler -> rank -> scheduler
+through a pair of semaphores. A rank yields control at every transport
+operation and every sleep; whenever more than one rank is runnable the
+scheduler consults the prescribed choice list (the DFS prefix from
+explore.py) and records the decision in `trail`, which is both the
+replayable schedule trace and the frontier the explorer branches on.
+
+Faults are part of the schedule: a crash is `SimCrash` (a BaseException,
+so the production code's `except Exception` / `except CoordError`
+recovery paths cannot swallow a dead process) raised at a named
+transport op; a delay makes a stored value invisible until a later
+virtual time; rank 0's crash or `close()` takes the in-memory server
+down, after which every op blocks to its deadline and raises
+`CoordTimeout` — exactly what `rpc_line_json` does against a dead
+server.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from bnsgcn_tpu.parallel.coord import CoordTimeout
+
+
+class SimCrash(BaseException):
+    """The modeled process died at this op. BaseException: a crash must
+    tear through every `except Exception` recovery path, like a real
+    SIGKILL would."""
+
+
+class _Aborted(BaseException):
+    """Scheduler shutdown: unwinds an actor that a finished run no longer
+    needs (internal — never surfaces in outcomes)."""
+
+
+class Actor:
+    """One rank: a thread that runs only while it holds the baton."""
+
+    def __init__(self, rank: int, fn):
+        self.rank = rank
+        self.fn = fn
+        self.go = threading.Semaphore(0)
+        self.state = "runnable"     # runnable|sleeping|done|crashed|
+                                    # aborted|failed
+        self.wake_at = 0.0
+        self.outcome = None         # fn's return value once done
+        self.ops: dict[str, int] = {}   # per-kind transport-op counters
+        self.cur = ("", 0)          # op in flight (for 'after' crashes)
+        self.thread: threading.Thread | None = None
+
+
+class Scheduler:
+    """One per explored schedule. `run()` drives the actors to quiescence
+    under the prescribed choice prefix and leaves the verdict in
+    `trail` / `hung` / each actor's state+outcome."""
+
+    def __init__(self, prescribed=(), branch_bound: int = 10,
+                 time_budget: float = 8.0, step_budget: int = 6000):
+        self.now = 0.0
+        self.actors: list[Actor] = []
+        self.back = threading.Semaphore(0)
+        self.trail: list[tuple[int, int]] = []  # (chosen, n_options)
+        self.prescribed = list(prescribed)
+        self.branch_bound = branch_bound
+        self.time_budget = time_budget
+        self.step_budget = step_budget
+        self.hung = False
+        self.crashes: set[tuple[int, str, int, str]] = set()
+                                    # (rank, op kind, nth, before|after)
+        self.on_crash = []          # callbacks(rank) — e.g. server teardown
+        self._by_thread: dict = {}
+        self._aborting = False
+        self._steps = 0
+
+    def spawn(self, rank: int, fn) -> Actor:
+        a = Actor(rank, fn)
+        self.actors.append(a)
+        return a
+
+    # -- called from actor threads (exactly one runs at a time, so the
+    # -- shared state needs no locking: handoff IS the mutual exclusion)
+
+    def clock(self) -> float:
+        return self.now
+
+    def sleep(self, dt: float):
+        a = self._current()
+        a.state = "sleeping"
+        a.wake_at = self.now + max(float(dt), 1e-6)
+        self._yield(a)
+
+    def op_yield(self, kind: str):
+        """Transport-op boundary: count it, fire a scheduled 'before'
+        crash, hand the baton back so peers can interleave."""
+        a = self._current()
+        n = a.ops.get(kind, 0) + 1
+        a.ops[kind] = n
+        a.cur = (kind, n)
+        if (a.rank, kind, n, "before") in self.crashes:
+            self._fire_crash(a)
+        self._yield(a)
+
+    def op_done(self):
+        a = self._current()
+        kind, n = a.cur
+        if (a.rank, kind, n, "after") in self.crashes:
+            self._fire_crash(a)
+
+    def _fire_crash(self, a: Actor):
+        for cb in self.on_crash:
+            cb(a.rank)
+        raise SimCrash(f"rank {a.rank} crashed at {a.cur[0]} #{a.cur[1]}")
+
+    def _current(self) -> Actor:
+        return self._by_thread[threading.current_thread()]
+
+    def _yield(self, a: Actor):
+        self.back.release()
+        a.go.acquire()
+        if self._aborting:
+            raise _Aborted()
+
+    def _actor_main(self, a: Actor):
+        self._by_thread[threading.current_thread()] = a
+        a.go.acquire()
+        try:
+            if self._aborting:
+                a.state = "aborted"
+                return
+            try:
+                a.outcome = a.fn()
+                a.state = "done"
+            except _Aborted:
+                a.state = "aborted"
+            except SimCrash:
+                a.state = "crashed"
+            except BaseException as ex:     # noqa: BLE001 — harness bug,
+                a.state = "failed"          # attributed as a finding
+                a.outcome = ("error", f"{type(ex).__name__}: {ex}")
+        finally:
+            self.back.release()
+
+    # -- the scheduler side --
+
+    def _choose(self, n: int) -> int:
+        if n == 1:
+            return 0
+        i = len(self.trail)
+        chosen = min(self.prescribed[i], n - 1) \
+            if i < len(self.prescribed) else 0
+        # beyond the branch bound the point is recorded with one option,
+        # so the explorer never branches there (bounded-depth DFS)
+        self.trail.append((chosen, n if i < self.branch_bound else 1))
+        return chosen
+
+    def run(self):
+        for a in self.actors:
+            a.thread = threading.Thread(
+                target=self._actor_main, args=(a,),
+                name=f"proto-rank{a.rank}", daemon=True)
+            a.thread.start()
+        try:
+            while True:
+                self._steps += 1
+                if self._steps > self.step_budget:
+                    self.hung = True
+                    return
+                runnable = sorted(
+                    (a for a in self.actors if a.state == "runnable"),
+                    key=lambda a: a.rank)
+                if not runnable:
+                    sleeping = [a for a in self.actors
+                                if a.state == "sleeping"]
+                    if not sleeping:
+                        return      # all terminal: quiescent
+                    t = min(a.wake_at for a in sleeping)
+                    if t > self.time_budget:
+                        self.hung = True
+                        return
+                    self.now = max(self.now, t)
+                    for a in sleeping:
+                        if a.wake_at <= self.now:
+                            a.state = "runnable"
+                    continue
+                a = runnable[self._choose(len(runnable))]
+                a.go.release()
+                self.back.acquire()
+        finally:
+            self._shutdown()
+
+    def _shutdown(self):
+        """Unwind every non-terminal actor (hung run / early return): grant
+        each the baton once so `_Aborted` propagates and its thread exits."""
+        self._aborting = True
+        for _ in range(len(self.actors) * 4 + self.step_budget):
+            live = [a for a in self.actors
+                    if a.state in ("runnable", "sleeping")]
+            if not live:
+                break
+            live[0].state = "runnable"
+            live[0].go.release()
+            self.back.acquire()
+        for a in self.actors:
+            if a.thread is not None:
+                a.thread.join(timeout=5.0)
+
+
+# ----------------------------------------------------------------------------
+# in-memory transport (the tcp-mode model)
+# ----------------------------------------------------------------------------
+
+class SimNet:
+    """Shared state of one simulated run: the rank-0 KV store plus the
+    observation channels the invariants read (op trace, successful
+    reads). `delays` holds pending message-delay faults as mutable
+    [key_substring, extra_seconds, remaining_count] cells."""
+
+    def __init__(self):
+        self.store: dict[str, tuple[str, float, float]] = {}
+                                    # key -> (value, put_at, visible_at)
+        self.server_up = True
+        self.trace: list[tuple[float, int, str, str]] = []
+                                    # (vtime, rank, op, key)
+        self.delays: list[list] = []
+        self.reads: set[tuple[int, str]] = set()
+
+
+class SimTransport:
+    """The production transport interface over `SimNet`. A down server
+    behaves like `rpc_line_json` against a dead endpoint: retry (modeled
+    as one virtual sleep) until the deadline, then `CoordTimeout`."""
+
+    def __init__(self, sched: Scheduler, net: SimNet, rank: int):
+        self.sched, self.net, self.rank = sched, net, rank
+
+    def _enter(self, op: str, key: str):
+        self.net.trace.append((self.sched.now, self.rank, op, key))
+        self.sched.op_yield(op)
+
+    def _down(self, op: str, key: str, deadline: float):
+        self.sched.sleep(max(deadline - self.sched.now, 1e-3))
+        raise CoordTimeout(
+            f"rank {self.rank}: coordinator unreachable "
+            f"(op {op!r} key {key!r})")
+
+    def put(self, key: str, value: str, deadline: float):
+        self._enter("put", key)
+        try:
+            if not self.net.server_up:
+                self._down("put", key, deadline)
+            visible = self.sched.now
+            for cell in self.net.delays:
+                sub, extra, remaining = cell
+                if remaining > 0 and sub in key:
+                    cell[2] -= 1
+                    visible += extra
+            self.net.store[key] = (value, self.sched.now, visible)
+        finally:
+            self.sched.op_done()
+
+    def try_get(self, key: str, deadline: float):
+        self._enter("get", key)
+        try:
+            if not self.net.server_up:
+                self._down("get", key, deadline)
+            hit = self.net.store.get(key)
+            if hit is None or hit[2] > self.sched.now:
+                return None
+            self.net.reads.add((self.rank, key))
+            return hit[0]
+        finally:
+            self.sched.op_done()
+
+    def delete(self, key: str, deadline: float):
+        self._enter("del", key)
+        try:
+            if not self.net.server_up:
+                self._down("del", key, deadline)
+            self.net.store.pop(key, None)
+        finally:
+            self.sched.op_done()
+
+    def dump(self, prefix: str, deadline: float) -> dict:
+        self._enter("dump", prefix)
+        try:
+            if not self.net.server_up:
+                self._down("dump", prefix, deadline)
+            now = self.sched.now
+            return {k: (v, now - t)
+                    for k, (v, t, vis) in self.net.store.items()
+                    if k.startswith(prefix) and vis <= now}
+        finally:
+            self.sched.op_done()
+
+    def close(self):
+        # rank 0 owns the server: its close (orderly exit) or crash
+        # (scheduler on_crash hook) takes the store down for everyone —
+        # the interleaving of close against peers' last fetches is the
+        # whole point of the confirm-phase scenarios
+        self._enter("close", "")
+        try:
+            if self.rank == 0:
+                self.net.server_up = False
+        finally:
+            self.sched.op_done()
+
+
+def make_file_transport(sched: Scheduler, root: str, rank: int):
+    """The REAL `FileTransport` (boot-token minting, pid probe, pin/unpin
+    — the code under test) against a throwaway directory, with its ops
+    yielding to the scheduler and its waits on the virtual clock.
+
+    Built as a subclass-per-call so the seeded-bug patches on
+    `FileTransport` itself (seeded.py) stay visible through `super()`."""
+    from bnsgcn_tpu.parallel.coord import FileTransport
+
+    class SimFileTransport(FileTransport):
+        def __init__(self):
+            super().__init__(root, rank)
+            self._clock = sched.clock
+            self._sleep = sched.sleep
+
+        def put(self, key, value, deadline):
+            sched.op_yield("put")
+            try:
+                return super().put(key, value, deadline)
+            finally:
+                sched.op_done()
+
+        def try_get(self, key, deadline):
+            sched.op_yield("get")
+            try:
+                return super().try_get(key, deadline)
+            finally:
+                sched.op_done()
+
+        def delete(self, key, deadline):
+            sched.op_yield("del")
+            try:
+                return super().delete(key, deadline)
+            finally:
+                sched.op_done()
+
+        def dump(self, prefix, deadline):
+            sched.op_yield("dump")
+            try:
+                return super().dump(prefix, deadline)
+            finally:
+                sched.op_done()
+
+    return SimFileTransport()

@@ -1,0 +1,281 @@
+"""Rule family 9 — lock-order discipline across the threaded subsystems.
+
+The repo has four subsystems that hold locks while other threads run
+(serve.py's batcher + refresh worker, resilience.py's watchdog, obs.py's
+event writer, coord.py's KV store). Family 5 checks that annotated state
+is touched under its lock; this family checks the locks AGAINST EACH
+OTHER:
+
+lock-order-cycle
+    Builds the cross-module lock-acquisition graph from lexically nested
+    ``with <lock>:`` blocks (multi-item ``with a, b:`` acquires in item
+    order) and flags every edge on a cycle — two locks taken in opposite
+    orders on different paths is the classic ABBA deadlock, and a
+    non-reentrant ``threading.Lock``/``Condition`` nested inside itself
+    is a self-deadlock. Reentrant locks (``threading.RLock``) may
+    self-nest; only their cross-lock cycles are flagged.
+
+lock-held-blocking-call
+    Flags unbounded-or-slow blocking calls made while a ``with <lock>:``
+    block is held: thread ``join()``, ``time.sleep``, ``os.fsync``,
+    socket I/O (``sendall``/``recv``/``accept``/``create_connection``),
+    and the coordinator RPC (``rpc_line_json``). A stalled disk or peer
+    inside such a call wedges every thread contending for the lock —
+    including the watchdog paths that exist to escape exactly that
+    state. ``cv.wait()`` is exempt (a Condition wait RELEASES the lock),
+    and ``.join`` with positional arguments is exempt (``",".join(xs)``
+    / ``os.path.join(a, b)`` are string/path joins, while thread joins
+    are spelled ``t.join()`` / ``t.join(timeout=...)``).
+
+Lock names are normalized per class (``self._lock`` in ``class Server``
+-> ``Server._lock``) so the graph joins the same lock across methods but
+keeps same-named locks of different classes distinct. An expression
+counts as a lock when its final attribute matches the naming convention
+(lock / mutex / cv / cond) or it was assigned a ``threading.Lock/RLock/
+Condition`` anywhere on the surface.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bnsgcn_tpu.analysis.astutil import call_name, qualname, tail
+from bnsgcn_tpu.analysis.core import Context, Finding, Module
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Final-attribute substrings that mark an expression as a lock by naming
+# convention. Deliberately narrow: events/flags (`_halt`, `_stop`) and
+# data fields must not enter the graph.
+_LOCK_NAME_HINTS = ("lock", "mutex", "cv", "cond")
+
+# threading constructors -> recorded kind (reentrancy decides whether a
+# self-edge is a deadlock)
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition",
+               "Semaphore": "Semaphore", "BoundedSemaphore": "Semaphore"}
+
+# call names (final attribute / qualname tail) that block while held
+_BLOCKING_ATTRS = {"fsync", "sleep", "sendall", "recv", "accept"}
+_BLOCKING_CALLS = {"socket.create_connection", "create_connection",
+                   "rpc_line_json"}
+
+
+def _enclosing_class(node: ast.AST, parents: dict) -> str:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = parents.get(cur)
+    return ""
+
+
+def _lock_name(expr: ast.AST, cls: str, ctx: Context) -> str | None:
+    """Normalized lock identity of a with-item context expr, or None when
+    the expression is not a lock. `self.X` -> `Cls.X`; other attribute
+    chains keep their source spelling (`self.core._lock` -> `core._lock`
+    — distinct from the owner's own `Cls._lock`, which is the point)."""
+    q = qualname(expr)
+    if not q:
+        return None
+    final = q.rsplit(".", 1)[-1].lower().lstrip("_")
+    name = q
+    if q.startswith("self."):
+        rest = q[len("self."):]
+        name = f"{cls}.{rest}" if "." not in rest and cls else rest
+    if any(h in final for h in _LOCK_NAME_HINTS):
+        return name
+    return name if name in ctx.lock_kinds else None
+
+
+def collect(mod: Module, ctx: Context):
+    """Pre-pass: record lock constructions (name -> kind) and every
+    nested-acquisition edge in this module into the shared context."""
+    parents = _parent_map(mod.tree)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = tail(call_name(node.value), 1)
+            kind = _LOCK_CTORS.get(ctor)
+            if kind is None or tail(call_name(node.value), 2) not in {
+                    f"threading.{ctor}", ctor}:
+                continue
+            cls = _enclosing_class(node, parents)
+            for t in node.targets:
+                q = qualname(t)
+                if not q:
+                    continue
+                if q.startswith("self.") and "." not in q[len("self."):]:
+                    q = f"{cls}.{q[len('self.'):]}" if cls else q
+                ctx.lock_kinds[q] = kind
+    for fn in ast.walk(mod.tree):
+        if isinstance(fn, _FUNC):
+            cls = _enclosing_class(fn, parents)
+            _walk_body(fn.body, [], cls, mod, ctx)
+
+
+def _walk_body(stmts, held: list, cls: str, mod: Module, ctx: Context):
+    """Record (held -> newly acquired) edges down one function body.
+    Containment does not cross def boundaries (a nested def runs later,
+    under whatever locks its CALLER holds — unknowable statically)."""
+    for node in stmts:
+        if isinstance(node, _FUNC) or isinstance(node, ast.ClassDef):
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                name = _lock_name(item.context_expr, cls, ctx)
+                if name is None:
+                    continue
+                for h in held + acquired:
+                    ctx.lock_edges.append((h, name, mod.relpath, node.lineno))
+                acquired.append(name)
+            _walk_body(node.body, held + acquired, cls, mod, ctx)
+            continue
+        _walk_body([c for c in ast.iter_child_nodes(node)
+                    if isinstance(c, ast.stmt)], held, cls, mod, ctx)
+
+
+def _cycle_edges(edges) -> set:
+    """Edges participating in any cycle of the lock graph: self-loops plus
+    every edge inside a strongly-connected component of size > 1."""
+    graph: dict[str, set] = {}
+    for a, b, _, _ in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    stack: list[str] = []
+    on: set[str] = set()
+    comp: dict[str, int] = {}
+    counter = [0]
+    ncomp = [0]
+
+    def strong(v):             # iterative Tarjan (lock graphs are tiny,
+        work = [(v, iter(sorted(graph[v])))]   # but avoid recursion limits)
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp[w] = ncomp[0]
+                    if w == node:
+                        break
+                ncomp[0] += 1
+
+    for v in sorted(graph):
+        if v not in index:
+            strong(v)
+    members: dict[int, int] = {}
+    for v, c in comp.items():
+        members[c] = members.get(c, 0) + 1
+    bad = set()
+    for a, b, relpath, line in edges:
+        if a == b or (comp.get(a) == comp.get(b) and members.get(comp.get(a),
+                                                                0) > 1):
+            bad.add((a, b, relpath, line))
+    return bad
+
+
+def check(mod: Module, ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    # -- cycles: global graph, findings attributed at each edge's own site
+    for a, b, relpath, line in sorted(_cycle_edges(ctx.lock_edges)):
+        if relpath != mod.relpath:
+            continue
+        if a == b and ctx.lock_kinds.get(a) == "RLock":
+            continue            # reentrant: legal self-nesting
+        what = (f"non-reentrant lock `{a}` acquired while already held"
+                if a == b else
+                f"`{b}` acquired while holding `{a}`, and the reverse "
+                f"order exists elsewhere in the lock graph")
+        out.append(Finding(
+            mod.relpath, line, 0, "lock-order-cycle",
+            f"lock-acquisition cycle: {what} — potential deadlock"))
+
+    # -- blocking calls under a held lock (lexical, same function)
+    parents = _parent_map(mod.tree)
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, _FUNC):
+            continue
+        cls = _enclosing_class(fn, parents)
+        _scan_blocking(fn.body, [], cls, fn.name, mod, ctx, out)
+    return out
+
+
+def _scan_blocking(stmts, held: list, cls: str, fn_name: str, mod: Module,
+                   ctx: Context, out: list):
+    for node in stmts:
+        if isinstance(node, _FUNC) or isinstance(node, ast.ClassDef):
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = [n for n in
+                        (_lock_name(i.context_expr, cls, ctx)
+                         for i in node.items) if n is not None]
+            _scan_blocking(node.body, held + acquired, cls, fn_name, mod,
+                           ctx, out)
+            continue
+        if held:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                blocked = _blocking_call(sub, held)
+                if blocked is not None:
+                    out.append(Finding(
+                        mod.relpath, sub.lineno, sub.col_offset,
+                        "lock-held-blocking-call",
+                        f"`{blocked}` called while holding "
+                        f"{', '.join(f'`{h}`' for h in held)} in "
+                        f"`{(cls + '.') if cls else ''}{fn_name}` — a "
+                        f"stall here wedges every contender"))
+            continue
+        _scan_blocking([c for c in ast.iter_child_nodes(node)
+                        if isinstance(c, ast.stmt)], held, cls, fn_name,
+                       mod, ctx, out)
+
+
+def _blocking_call(call: ast.Call, held: list) -> str | None:
+    name = call_name(call)
+    if not name:
+        return None
+    final = name.rsplit(".", 1)[-1]
+    if name in _BLOCKING_CALLS or tail(name) in _BLOCKING_CALLS:
+        return name
+    if final == "join":
+        # thread joins carry no positional args (t.join() /
+        # t.join(timeout=...)); string/path joins always do
+        return name if not call.args else None
+    if final == "wait":
+        # cv.wait(...) on a HELD Condition releases the lock — correct
+        # usage, not a hazard. A wait on anything else under a lock
+        # (event.wait) would block while held, but distinguishing the
+        # receiver statically is guesswork; family 5 guards the state.
+        return None
+    if final in _BLOCKING_ATTRS:
+        return name
+    return None
+
+
+def _parent_map(tree: ast.AST) -> dict:
+    from bnsgcn_tpu.analysis.astutil import parent_map
+    return parent_map(tree)

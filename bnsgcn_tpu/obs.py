@@ -70,6 +70,9 @@ EVENT_KINDS = (
     "strict_exec",
     # jaxpr-level static preflight (analysis/ir, `-m bnsgcn_tpu.analysis ir`)
     "ir_audit",
+    # protocol model-checking preflight (analysis/proto,
+    # `-m bnsgcn_tpu.analysis proto`)
+    "proto_audit",
 )
 
 

@@ -334,6 +334,11 @@ class FileTransport:
         self.root = root
         self._rank = rank
         os.makedirs(root, exist_ok=True)
+        # same time seam as Coordinator: the `.boot` poll below goes
+        # through these so analysis/proto can explore relaunch races
+        # under a virtual clock (production: the stdlib functions).
+        self._clock = time.monotonic
+        self._sleep = time.sleep
         self._token: Optional[str] = None
         self._pinned = False        # peers: token confirmed by a real get
         if rank == 0:
@@ -363,11 +368,11 @@ class FileTransport:
             if tok is not None and not _token_is_dead(tok):
                 self._token = tok
                 break
-            if time.monotonic() >= deadline:
+            if self._clock() >= deadline:
                 raise CoordTimeout(
                     f"rank {self._rank}: no {self.BOOT} run token in "
                     f"{self.root} (is rank 0 up?)")
-            time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
+            self._sleep(min(delay, max(deadline - self._clock(), 0)))
             delay = min(delay * 2, 0.5)
         return self._token
 
@@ -455,6 +460,12 @@ class Coordinator:
         self.transport = transport
         self.timeout_s = float(timeout_s)
         self.log = log
+        # time seam: every wait in this class goes through these two
+        # attributes so the protocol checker (analysis/proto) can run the
+        # real collectives under a virtual clock. Production constructs
+        # nothing extra — these ARE the stdlib functions.
+        self._clock = time.monotonic
+        self._sleep = time.sleep
         self.last_infos: dict[int, dict] = {}   # rank 0: the piggybacked
                             # per-rank info payloads of the latest agree()
                             # (obs epoch summaries — merged into ONE
@@ -468,8 +479,8 @@ class Coordinator:
     # -- plumbing --
 
     def _deadline(self, timeout_s: Optional[float] = None) -> float:
-        return time.monotonic() + (self.timeout_s if timeout_s is None
-                                   else timeout_s)
+        return self._clock() + (self.timeout_s if timeout_s is None
+                                else timeout_s)
 
     def _get(self, key: str, deadline: float, what: str) -> str:
         """Blocking get with poll backoff; CoordTimeout (after a liveness
@@ -477,8 +488,9 @@ class Coordinator:
         (2 ms) because this sits on the healthy per-epoch agree path —
         every peer's first decision fetch almost always misses while rank 0
         gathers, and a 20 ms granularity there would tax fast full-graph
-        epochs by a comparable amount; backoff still caps at 0.5 s so a
-        genuinely absent peer costs ~2 polls/s, not a busy loop."""
+        epochs by a comparable amount; backoff caps at 50 ms so a pending
+        key costs at most one extra poll interval of latency while an
+        absent peer costs ~20 polls/s, not a busy loop burning a core."""
         delay = 0.002
         while True:
             try:
@@ -488,13 +500,13 @@ class Coordinator:
                                 # descriptive raise (with liveness) below
             if v is not None:
                 return v
-            if time.monotonic() >= deadline:
+            if self._clock() >= deadline:
                 self.log_liveness()
                 raise CoordTimeout(
                     f"rank {self.rank}: timed out waiting for {what} "
                     f"(key {key!r}; per-exchange bound {self.timeout_s:.1f}s)")
-            time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
-            delay = min(delay * 2, 0.5)
+            self._sleep(min(delay, max(deadline - self._clock(), 0)))
+            delay = min(delay * 2, 0.05)
 
     def _put(self, key: str, value: str, deadline: Optional[float] = None):
         self.transport.put(key, value,

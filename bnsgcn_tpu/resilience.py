@@ -453,6 +453,15 @@ class ResilienceManager:
         self.rollbacks: list[dict] = []     # surfaced on RunResult
         self._signals = PreemptSignals(action="checkpoint",
                                        profile=obs is not None)
+        # decide/ack seam: the rollback paths reach checkpoint I/O and the
+        # backoff sleep ONLY through these attributes, so the protocol
+        # checker (analysis/proto) can drive the real plan_rollback /
+        # coord_restore logic against fake payloads under a virtual clock.
+        # Production constructs nothing extra — these ARE the real functions.
+        self._find_ckpt = ckpt.latest_valid_checkpoint
+        self._load_ckpt = ckpt.load_checkpoint
+        self._restore_into = ckpt.restore_into
+        self._sleep = time.sleep
         self._snapshot = None
         self._pending_payload = None    # rank 0: the checkpoint payload
                                         # plan_rollback just validated, so
@@ -526,8 +535,7 @@ class ResilienceManager:
         """
         self.retries += 1
         limit = max(int(self.cfg.resil_retries), 0)
-        found = ckpt.latest_valid_checkpoint(self.cfg, log=self.log,
-                                             before_epoch=epoch)
+        found = self._find_ckpt(self.cfg, log=self.log, before_epoch=epoch)
         if self.retries > limit:
             raise DivergenceError(self._report(epoch, loss_f, found))
         backoff = min(self.backoff_cap,
@@ -535,10 +543,10 @@ class ResilienceManager:
         if backoff > 0:
             self.log(f"[resilience] backing off {backoff:.1f}s before retry "
                      f"{self.retries}/{limit}")
-            time.sleep(backoff)
+            self._sleep(backoff)
         if found is not None:
             path, payload = found
-            p, o, s = ckpt.restore_into(payload, params_t, opt_t, state_t)
+            p, o, s = self._restore_into(payload, params_t, opt_t, state_t)
             restart = int(payload["epoch"]) + 1
             src = os.path.basename(path)
         else:
@@ -670,8 +678,7 @@ class ResilienceManager:
         (see rollback's docstring for why they are not one function)."""
         self.retries += 1
         limit = max(int(self.cfg.resil_retries), 0)
-        found = ckpt.latest_valid_checkpoint(self.cfg, log=self.log,
-                                             before_epoch=epoch)
+        found = self._find_ckpt(self.cfg, log=self.log, before_epoch=epoch)
         if self.retries > limit:
             return {"decision": "abort", "why": "divergence",
                     "report": self._report(epoch, loss_f, found)}
@@ -724,7 +731,7 @@ class ResilienceManager:
             self.log(f"[resilience] backing off {backoff:.1f}s before "
                      f"agreed retry {decision.get('retry')}"
                      f"/{decision.get('limit')}")
-            time.sleep(backoff)
+            self._sleep(backoff)
         src = decision["source"]
         ok, err, out = True, "", (params_t, opt_t, state_t)
         if restore_local:
@@ -736,9 +743,10 @@ class ResilienceManager:
                 else:
                     payload = self._pending_payload
                     if payload is None:
-                        payload = ckpt.load_checkpoint(
+                        payload = self._load_ckpt(
                             os.path.join(self.cfg.ckpt_path, src))
-                    out = ckpt.restore_into(payload, params_t, opt_t, state_t)
+                    out = self._restore_into(payload, params_t, opt_t,
+                                             state_t)
             except (ckpt.CheckpointCorrupt, CheckpointUnavailable,
                     OSError) as ex:
                 ok, err = False, f"{type(ex).__name__}: {ex}"

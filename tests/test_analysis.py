@@ -343,6 +343,113 @@ class Box:
 
 
 # ----------------------------------------------------------------------------
+# family 9: lock-order discipline
+# ----------------------------------------------------------------------------
+
+_LOCKORDER = {"lock-order-cycle", "lock-held-blocking-call"}
+
+
+def test_lockorder_abba_cycle_fires(tmp_path):
+    active, _, _ = lint_dir(tmp_path, {"fix_lo.py": """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+
+    def fwd(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+
+    def rev(self):
+        with self._lock_b:
+            with self._lock_a:
+                pass
+"""}, select=_LOCKORDER)
+    # both edges of the ABBA pair are on the cycle — one finding each
+    assert rules(active) == ["lock-order-cycle", "lock-order-cycle"]
+    assert "Box._lock_a" in (active[0].message + active[1].message)
+    assert "reverse order" in active[0].message
+
+
+def test_lockorder_self_nest_lock_fires_rlock_clean(tmp_path):
+    active, _, _ = lint_dir(tmp_path, {"fix_lo.py": """\
+import threading
+
+class Plain:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        with self._lock:
+            with self._lock:
+                pass
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def f(self):
+        with self._lock:
+            with self._lock:
+                pass
+"""}, select=_LOCKORDER)
+    assert rules(active) == ["lock-order-cycle"]
+    assert "Plain._lock" in active[0].message
+
+
+def test_lockorder_consistent_order_clean(tmp_path):
+    active, _, _ = lint_dir(tmp_path, {"fix_lo.py": """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+
+    def f(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+
+    def g(self):
+        with self._lock_a, self._lock_b:
+            pass
+"""}, select=_LOCKORDER)
+    assert active == []
+
+
+def test_lockorder_blocking_call_under_lock(tmp_path):
+    active, _, _ = lint_dir(tmp_path, {"fix_lo.py": """\
+import os
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def flush(self, fd, t):
+        with self._lock:
+            os.fsync(fd)        # blocks every contender on a slow disk
+            t.join()            # thread join: unbounded
+
+    def fine(self, xs):
+        with self._lock:
+            s = ",".join(xs)    # string join: not a thread join
+        with self._cv:
+            self._cv.wait()     # releases the lock while waiting
+        return s
+"""}, select=_LOCKORDER)
+    assert rules(active) == ["lock-held-blocking-call",
+                             "lock-held-blocking-call"]
+    assert any("fsync" in f.message for f in active)
+    assert any("join" in f.message for f in active)
+
+
+# ----------------------------------------------------------------------------
 # family 6: contract lints (obs registry, exit codes)
 # ----------------------------------------------------------------------------
 
@@ -524,9 +631,11 @@ def test_lint_sh_clean_at_head(tmp_path):
     fault_matrix.sh and the quickgate tier both invoke)."""
     env = _env()
     env["LINT_REPORT"] = str(tmp_path / "lint_report.json")
-    # gate 1 only: the IR tier's clean-at-HEAD run is its own quickgate
-    # (test_analysis_ir.test_ir_audit_clean_at_head) — no double matrix
+    # gate 1 only: the IR and proto tiers' clean-at-HEAD runs are their
+    # own quickgates (test_analysis_ir.test_ir_audit_clean_at_head,
+    # test_analysis_proto.test_proto_audit_clean_at_head) — no doubling
     env["LINT_SKIP_IR"] = "1"
+    env["LINT_SKIP_PROTO"] = "1"
     r = subprocess.run(["bash", "tools/lint.sh"], capture_output=True,
                        text=True, timeout=300, cwd=REPO, env=env)
     assert r.returncode == 0, r.stdout + r.stderr

@@ -213,6 +213,33 @@ def test_get_times_out_with_bounded_wait(coord_pair):
     assert 0.9 <= waited < 5.0          # bounded: no way to hang forever
 
 
+def test_get_poll_backoff_caps_at_50ms():
+    """Pin the _get poll schedule: 2 ms initial, exponential, capped at
+    50 ms. An absent key over a 1 s window must cost ~25 polls (~20/s at
+    the cap) — the earlier 0.5 s cap left only ~10, adding up to half a
+    second of discovery latency to every healthy decision fetch."""
+    polls = []
+    slept = []
+
+    class _Absent:
+        def try_get(self, key, deadline):
+            polls.append(key)
+            return None
+
+        def dump(self, prefix, deadline):
+            return {}                   # liveness snapshot on the timeout
+
+    c = Coordinator(0, 2, _Absent(), 1.0, log=lambda *a: None)
+    t = [0.0]
+    c._clock = lambda: t[0]
+    c._sleep = lambda dt: (slept.append(dt), t.__setitem__(0, t[0] + dt))
+    with pytest.raises(CoordTimeout, match="key 'nope'"):
+        c._get("nope", 1.0, "a peer that never answers")
+    assert 20 <= len(polls) <= 30, len(polls)
+    assert max(slept) == pytest.approx(0.05)    # the cap
+    assert slept[0] == pytest.approx(0.002)     # fine-grained first poll
+
+
 def test_tcp_client_times_out_when_no_server():
     t = TcpTransport("127.0.0.1", 1, serve=False)   # nothing listens on :1
     c = Coordinator(1, 2, t, 1.0, log=lambda *a: None)

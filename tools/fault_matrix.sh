@@ -60,7 +60,10 @@ echo "== graftlint: the repo must be static-analysis clean =="
 # hazards the matrix exercises at runtime (deadlock-prone collectives,
 # exit-code drift, unguarded shared state) are exactly what the lint
 # proves absent from the source first; a dirty tree fails the matrix
-# before any training run spends time
+# before any training run spends time. This runs all three tiers —
+# AST, IR, and the protocol model checker (gate 3), whose enumerated
+# crash/delay schedules subsume the single interleaving each matrix
+# cell below happens to hit.
 bash tools/lint.sh -q > "$WORK/lint.log" 2>&1
 check lint 0 $?
 

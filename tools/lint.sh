@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# tools/lint.sh — the graftlint CI gate, both tiers.
+# tools/lint.sh — the graftlint CI gate, all three tiers.
 #
 # Gate 1 (AST): the repo-native static-analysis suite over the default
 # lint surface (bnsgcn_tpu/, tools/, bench.py, __graft_entry__.py),
@@ -8,16 +8,23 @@
 # every tune-reachable step/eval/exchange program on a host-only
 # abstract mesh and verifies the collective/donation/wire/transfer
 # contracts; report to tools/ir_report.json (override with
-# IR_REPORT=path). Skipped when gate 1 fails (same signal, cheaper) or
+# IR_REPORT=path).
+# Gate 3 (proto): the coordination-protocol model checker
+# (`analysis proto`) — runs the real Coordinator/ResilienceManager code
+# under a deterministic scheduler across enumerated interleavings and
+# fault schedules; report to tools/proto_report.json (override with
+# PROTO_REPORT=path).
+# Gates 2 and 3 are skipped when gate 1 fails (same signal, cheaper) or
 # when explicit paths are passed (file-scoped lint run).
 #
-# Exit code: the first failing gate's — 0 clean, 1 findings, 2 parse or
-# trace errors — straight from `python -m bnsgcn_tpu.analysis`.
-# LINT_SKIP_IR=1 runs gate 1 only (the IR tier traces ~60 programs,
-# ~2 min on a laptop CPU).
+# Exit code: the first failing gate's — 0 clean, 1 findings, 2 parse/
+# trace/explore errors — straight from `python -m bnsgcn_tpu.analysis`.
+# LINT_SKIP_IR=1 skips gate 2 (the IR tier traces ~60 programs, ~2 min
+# on a laptop CPU); LINT_SKIP_PROTO=1 skips gate 3 (~2000 schedules,
+# a few seconds).
 #
 # Usage:
-#   tools/lint.sh                  # full default surface, both gates
+#   tools/lint.sh                  # full default surface, all gates
 #   tools/lint.sh bnsgcn_tpu/run.py  # specific files/dirs (AST only)
 #   LINT_REPORT=/tmp/r.json tools/lint.sh
 set -u
@@ -25,6 +32,7 @@ cd "$(dirname "$0")/.."
 
 REPORT="${LINT_REPORT:-tools/lint_report.json}"
 IR_REPORT="${IR_REPORT:-tools/ir_report.json}"
+PROTO_REPORT="${PROTO_REPORT:-tools/proto_report.json}"
 PY="${PYTHON:-python}"
 
 # The AST tier is pure-AST (no jax import), but keep the env pinned the
@@ -38,8 +46,8 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
-# gate 2 only on full-surface runs: explicit paths mean a file-scoped
-# AST pass, and the IR matrix is path-independent anyway
+# gates 2+3 only on full-surface runs: explicit paths mean a file-scoped
+# AST pass, and the IR matrix / protocol schedules are path-independent
 if [ "$#" -eq 0 ] || { [ "$#" -eq 1 ] && [ "${1:-}" = "-q" ]; }; then
     if [ "${LINT_SKIP_IR:-0}" != "1" ]; then
         JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
@@ -48,6 +56,16 @@ if [ "$#" -eq 0 ] || { [ "$#" -eq 1 ] && [ "${1:-}" = "-q" ]; }; then
         if [ "$rc" -ne 0 ]; then
             echo "lint.sh: graftlint-ir gate FAILED (rc=$rc, report:" \
                  "$IR_REPORT)" >&2
+            exit "$rc"
+        fi
+    fi
+    if [ "${LINT_SKIP_PROTO:-0}" != "1" ]; then
+        JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
+            "$PY" -m bnsgcn_tpu.analysis proto --json "$PROTO_REPORT" "$@"
+        rc=$?
+        if [ "$rc" -ne 0 ]; then
+            echo "lint.sh: graftcheck-proto gate FAILED (rc=$rc, report:" \
+                 "$PROTO_REPORT)" >&2
             exit "$rc"
         fi
     fi

@@ -50,10 +50,14 @@ LIFECYCLE_KINDS = ("inject", "rollback", "preempt", "watchdog_fire",
                    "profile", "halo_refresh", "strict_exec",
                    "reorder", "layout_build", "tune_decision")
 
+# static-preflight verdicts (lint.sh gates 2 and 3 with --obs-log): the
+# audit that gated a pod run sits in the same log as the run it gated
+AUDIT_KINDS = ("ir_audit", "proto_audit")
+
 # the report's sub-vocabularies must stay inside the bus registry —
 # graftlint checks the emit sites, this checks the reader
-assert set(LIFECYCLE_KINDS) <= set(EVENT_KINDS), \
-    sorted(set(LIFECYCLE_KINDS) - set(EVENT_KINDS))
+assert set(LIFECYCLE_KINDS) | set(AUDIT_KINDS) <= set(EVENT_KINDS), \
+    sorted((set(LIFECYCLE_KINDS) | set(AUDIT_KINDS)) - set(EVENT_KINDS))
 
 
 def load_run(paths: list[str]) -> list[dict]:
@@ -81,7 +85,7 @@ def summarize(events: list[dict]) -> dict:
     """Structured digest of one run's events (the --json output)."""
     out: dict = {"header": None, "epochs": {}, "evals": {}, "lifecycle": [],
                  "epoch_ranks": [], "serve": None, "serve_header": None,
-                 "run_end": None, "traces": [], "bench": [],
+                 "run_end": None, "traces": [], "bench": [], "audits": [],
                  "unknown_kinds": {}}
     for ev in events:
         k = ev.get("kind")
@@ -97,6 +101,8 @@ def summarize(events: list[dict]) -> dict:
             out["evals"][int(ev["epoch"])] = ev
         elif k in LIFECYCLE_KINDS:
             out["lifecycle"].append(ev)
+        elif k in AUDIT_KINDS:
+            out["audits"].append(ev)
         elif k == "epoch_ranks":
             out["epoch_ranks"].append(ev)
         elif k == "serve_drain":
@@ -200,6 +206,24 @@ def render(s: dict, write=print):
                   if trig else "")
             write(f"  {int(_num(ev.get('epoch'))):5d}   {ch:<30}  "
                   f"{ev.get('reason')}{tr}")
+    if s["audits"]:
+        write("")
+        write("preflight audits:")
+        for ev in s["audits"]:
+            ok = "clean" if ev.get("ok") else "FAIL"
+            if ev["kind"] == "ir_audit":
+                scope = f"{ev.get('n_variants')} variant(s)"
+            else:
+                scope = (f"{ev.get('n_schedules')} schedule(s) / "
+                         f"{ev.get('n_scenarios')} scenario(s)")
+            counts = ev.get("counts") or {}
+            by_rule = (" [" + " ".join(f"{k}x{v}"
+                                       for k, v in sorted(counts.items()))
+                       + "]" if counts else "")
+            write(f"  {ev['kind']}: {ok} — {scope}, "
+                  f"{ev.get('n_findings')} finding(s), "
+                  f"{ev.get('errors')} error(s) in {ev.get('elapsed_s')} s"
+                  + by_rule)
     epochs = s["epochs"]
     if epochs:
         ranks = sorted({r for by_r in epochs.values() for r in by_r})
