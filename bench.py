@@ -438,6 +438,47 @@ def _supervise(args) -> int:
     return 0
 
 
+def _serve_dispatch(args) -> int:
+    """--serve mode: measure ONLINE SERVING latency/throughput instead of
+    training epoch time. Runs tools/serve_bench.py once per requested
+    variant (serve1 = single-host server, serve2p = 2-part router-fronted
+    fleet); the child inherits stdout, so its backend-count-tagged
+    SERVE_METRICS JSON lines land in the same last-line-wins pipe the
+    driver already captures. Host-side by construction (the serving tier
+    is host numpy plus a one-shot table precompute), so this path skips
+    the TPU supervisor/probe machinery entirely — there is no tunnel to
+    babysit and nothing to carry forward."""
+    import subprocess
+    variants = {"serve1": [("serve1", 0)], "serve2p": [("serve2p", 2)],
+                "both": [("serve1", 0), ("serve2p", 2)]}[args.serve]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "serve_bench.py")
+    rc_worst = 0
+    for variant, fleet in variants:
+        cmd = [sys.executable, script, "--json-only",
+               "--requests", str(args.serve_requests),
+               "--concurrency", str(args.serve_concurrency),
+               "--variant", variant]
+        if fleet:
+            cmd += ["--fleet", str(fleet)]
+        print(f"serve bench: {variant} "
+              + (f"({fleet} sharded backends + router)" if fleet
+                 else "(single-host server)"), file=sys.stderr, flush=True)
+        try:
+            rc = subprocess.run(cmd, env=env,
+                                timeout=args.budget_s).returncode
+        except subprocess.TimeoutExpired:
+            print(f"serve bench: {variant} hit the {args.budget_s:.0f}s "
+                  f"budget; killed", file=sys.stderr, flush=True)
+            rc = -9
+        if rc != 0:
+            print(f"serve bench: {variant} exited rc={rc}",
+                  file=sys.stderr, flush=True)
+            rc_worst = rc_worst or (rc if rc > 0 else 1)
+    return rc_worst
+
+
 def _features(label: np.ndarray, n_feat=602, n_class=41) -> np.ndarray:
     """Label-correlated features from a dedicated RNG stream — identical on
     cold and warm runs (the cache stores only edges/labels/masks)."""
@@ -590,6 +631,18 @@ def main():
                          "JSON carries the log's path — hardware-window "
                          "runs become post-hoc auditable with "
                          "tools/obs_report.py --compare")
+    ap.add_argument("--serve", choices=["off", "serve1", "serve2p", "both"],
+                    default="off",
+                    help="measure online serving instead of epoch time: "
+                         "run tools/serve_bench.py per variant (serve1 = "
+                         "single-host server, serve2p = 2-part router-"
+                         "fronted fleet; both = the comparison pair) and "
+                         "emit backend-count-tagged SERVE_METRICS lines "
+                         "through the same driver pipe")
+    ap.add_argument("--serve-requests", type=int, default=200,
+                    help="--serve: timed requests per tier per variant")
+    ap.add_argument("--serve-concurrency", type=int, default=4,
+                    help="--serve: concurrent client threads")
     ap.add_argument("--probe-timeout-s", type=float, default=150.0,
                     help="supervisor: per-probe subprocess timeout (a "
                          "wedged tunnel HANGS jax.devices() forever)")
@@ -603,6 +656,12 @@ def main():
     if args.hard_timeout_s is None:
         args.hard_timeout_s = args.budget_s + 1500.0
     t_start = time.time()
+
+    if args.serve != "off":
+        # serving bench: dispatched BEFORE the supervisor re-exec — the
+        # children run on the host platform and must not inherit the
+        # worker env / TPU probe lifecycle
+        sys.exit(_serve_dispatch(args))
 
     if not args.prep_only and os.environ.get("BNSGCN_BENCH_WORKER") != "1":
         sys.exit(_supervise(args))

@@ -251,6 +251,33 @@ class Config:
                                         # embedding table (penultimate
                                         # activations + final-layer logits,
                                         # checkpoint integrity header) here
+    serve_compact_deltas: int = 0       # delta-log compaction threshold: at
+                                        # >= N logged deltas, snapshot the
+                                        # mutated graph + tables (write_blob
+                                        # integrity header) and truncate the
+                                        # log to a tail so relaunch replay is
+                                        # O(snapshot + tail); 0 = never
+                                        # compact (full replay, PR-7 exact)
+    # --- partition-sharded distributed serving (serve_router.py /
+    # serve_backend.py; `serve-router` + `serve-backend` subcommands) ---
+    parts: int = 0                      # serving fleet width: number of
+                                        # partition shards the router expects
+                                        # backends for; 0 = read it from the
+                                        # partition artifacts' meta.json
+    part_replicas: int = 1              # read replicas per part behind the
+                                        # router (deltas broadcast to all,
+                                        # reads round-robined)
+    serve_part: int = -1                # which partition shard THIS backend
+                                        # process owns (serve-backend only)
+    serve_replica: int = 0              # this backend's replica ordinal
+                                        # within its part (serve-backend)
+    serve_backend_port: int = 0         # backend listen port (serve-backend;
+                                        # 0 = ephemeral, reported to the
+                                        # router at registration)
+    serve_router: str = ""              # router address a backend registers
+                                        # with / clients connect to, as
+                                        # 'host:port' (default
+                                        # 127.0.0.1:{serve_port})
 
     # --- observability (obs.py: unified telemetry bus) ---
     obs: str = "on"                     # 'on' (process-wide metrics registry +
@@ -434,6 +461,28 @@ def create_parser() -> argparse.ArgumentParser:
     both("dump-embeddings", type=str, default="",
          help="write the all-node embedding table (+ integrity header) "
               "here after eval — serve.py cold-starts from it")
+    both("serve-compact-deltas", type=int, default=0,
+         help="compact the serving delta log past N entries: integrity-"
+              "headed snapshot + truncated tail, so relaunch replay is "
+              "O(snapshot + tail) instead of O(all deltas ever); 0 = off")
+    # partition-sharded distributed serving (serve_router/serve_backend)
+    p.add_argument("--parts", type=int, default=0,
+                   help="serving fleet width (number of partition shards "
+                        "the router fronts); 0 = read it from the partition "
+                        "artifacts' meta.json")
+    both("part-replicas", type=int, default=1,
+         help="read replicas per part behind the serving router (deltas "
+              "broadcast, reads round-robined)")
+    both("serve-part", type=int, default=-1,
+         help="partition shard this serve-backend owns")
+    both("serve-replica", type=int, default=0,
+         help="replica ordinal of this serve-backend within its part")
+    both("serve-backend-port", type=int, default=0,
+         help="serve-backend listen port (0 = ephemeral; reported to the "
+              "router at registration)")
+    both("serve-router", type=str, default="",
+         help="router 'host:port' a serve-backend registers with (default "
+              "127.0.0.1:{serve-port})")
     # observability (obs.py)
     p.add_argument("--obs", type=str, default="on", choices=["on", "off"],
                    help="unified telemetry bus: metrics registry + "

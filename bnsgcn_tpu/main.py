@@ -35,6 +35,17 @@ def main(argv=None):
         # dispatch before the training config/seed handling below
         from bnsgcn_tpu import serve
         return serve.serve_main(argv[1:])
+    if argv and argv[0] == "serve-router":
+        # partition-sharded serving, router half: fronts one backend fleet
+        # (per-part shards x replicas), owns routing + delta fan-out;
+        # imports no model code until the CLI body runs
+        from bnsgcn_tpu import serve_router
+        return serve_router.router_main(argv[1:])
+    if argv and argv[0] == "serve-backend":
+        # partition-sharded serving, backend half: one process per
+        # (part, replica) owning that shard's table/CSR/delta state
+        from bnsgcn_tpu import serve_backend
+        return serve_backend.backend_main(argv[1:])
     cfg = parse_config(argv)
     if not cfg.fix_seed:
         # reference randomizes the seed unless --fix-seed (main.py:13-16)

@@ -62,8 +62,9 @@ EVENT_KINDS = (
     # resilience (resilience.py: injections, rollback consensus, exits)
     "inject", "rollback", "divergence_abort", "coord_decision",
     "watchdog_fire", "preempt", "profile_request", "profile",
-    # serving (serve.py)
-    "serve_header", "serve_drain", "delta",
+    # serving (serve.py; serve_router.py / serve_backend.py for the
+    # partition-sharded fleet)
+    "serve_header", "serve_drain", "delta", "serve_fleet", "serve_compact",
     # benchmarking (bench.py)
     "bench_header", "bench_variant", "bench_end",
     # strict-execution guard (strict.py, --strict-exec)

@@ -157,17 +157,28 @@ class _LineJsonHandler(socketserver.StreamRequestHandler):
 
     def handle(self):
         try:
-            line = self.rfile.readline(1 << 20)
-            if not line:
-                return
-            req = json.loads(line)
-            try:
-                resp = self.server.handle_fn(req)         # type: ignore[attr-defined]
-            except Exception as ex:                       # noqa: BLE001
-                # a handler bug answers the one request with an error —
-                # it never takes the server (or its siblings) down
-                resp = {"ok": False, "err": f"{type(ex).__name__}: {ex}"}
-            self.wfile.write(json.dumps(resp).encode() + b"\n")
+            self.connection.setsockopt(socket.IPPROTO_TCP,
+                                       socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        try:
+            # persistent connections: keep answering request lines until the
+            # client closes (one-shot clients send one line then FIN, so the
+            # loop exits promptly; pooled clients amortize the TCP handshake
+            # across many requests — the router -> backend forwarding path)
+            while True:
+                line = self.rfile.readline(1 << 20)
+                if not line:
+                    return
+                req = json.loads(line)
+                try:
+                    resp = self.server.handle_fn(req)     # type: ignore[attr-defined]
+                except Exception as ex:                   # noqa: BLE001
+                    # a handler bug answers the one request with an error —
+                    # it never takes the server (or its siblings) down
+                    resp = {"ok": False, "err": f"{type(ex).__name__}: {ex}"}
+                self.wfile.write(json.dumps(resp).encode() + b"\n")
+                self.wfile.flush()
         except (OSError, ValueError, KeyError):
             pass        # a torn request never takes the server down
 
@@ -227,6 +238,7 @@ def rpc_line_json(addr: str, port: int, req: dict, deadline: float,
             with socket.create_connection(
                     (addr, port),
                     timeout=min(max(remaining, 0.05), 5.0)) as s:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 s.settimeout(max(remaining, 0.05) if not retry_sent
                              else min(max(remaining, 0.05), 10.0))
                 s.sendall(json.dumps(req).encode() + b"\n")
@@ -250,6 +262,77 @@ def rpc_line_json(addr: str, port: int, req: dict, deadline: float,
                 f"non-idempotent request")
         time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
         delay = min(delay * 2, 1.0)
+
+
+class LineJsonClient:
+    """Pooled persistent connection to one LineJsonServer peer.
+
+    Amortizes the per-request TCP handshake the one-shot `rpc_line_json`
+    pays: the socket stays open across calls (the handler loop on the server
+    side keeps answering lines until EOF). ONLY safe for idempotent requests
+    — on a torn response the request is retried ONCE over a fresh
+    connection, so a non-idempotent op could execute twice; route those
+    through `rpc_line_json(..., retry_sent=False)` instead.
+
+    Thread-safe: one in-flight request at a time per client (the line
+    protocol has no request ids to demux interleaved responses)."""
+
+    def __init__(self, addr: str, port: int, timeout_s: float = 30.0,
+                 what: str = "peer"):
+        self.addr, self.port = addr, port
+        self.timeout_s = timeout_s
+        self.what = what
+        self._lock = threading.Lock()
+        self._sock = None           # guarded-by: self._lock
+        self._rfile = None          # guarded-by: self._lock
+
+    def _connect_locked(self):
+        s = socket.create_connection((self.addr, self.port),
+                                     timeout=self.timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(self.timeout_s)
+        self._sock, self._rfile = s, s.makefile("rb")
+
+    def _close_locked(self):
+        for f in (self._rfile, self._sock):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+        self._sock = self._rfile = None
+
+    def _round_trip_locked(self, payload: bytes) -> dict:
+        if self._sock is None:
+            self._connect_locked()
+        self._sock.sendall(payload)
+        line = self._rfile.readline(1 << 20)
+        if not line:
+            raise OSError("connection closed by peer")
+        return json.loads(line)
+
+    def request(self, req: dict) -> dict:
+        """One idempotent round trip; retries once on a fresh connection."""
+        payload = json.dumps(req).encode() + b"\n"
+        with self._lock:
+            try:
+                return self._round_trip_locked(payload)
+            except (OSError, ValueError):
+                # stale pooled socket (idle-timeout FIN, peer restart):
+                # retry exactly once over a fresh connection
+                self._close_locked()
+                try:
+                    return self._round_trip_locked(payload)
+                except (OSError, ValueError) as ex:
+                    self._close_locked()
+                    raise CoordTimeout(
+                        f"{self.what} at {self.addr}:{self.port} "
+                        f"unreachable (op {req.get('op')!r}): "
+                        f"{type(ex).__name__}: {ex}") from ex
+
+    def close(self):
+        with self._lock:
+            self._close_locked()
 
 
 def _kv_handle(store: _KVStore, req: dict) -> dict:

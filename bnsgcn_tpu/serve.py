@@ -65,6 +65,16 @@ from bnsgcn_tpu.models.gnn import (GraphEnv, ModelSpec, apply_model,
 from bnsgcn_tpu.parallel import coord as coord_mod
 
 DELTA_LOG = "delta_log.jsonl"
+SNAPSHOT = "serve_snapshot.blob"
+
+
+class HaloCacheMiss(RuntimeError):
+    """A tier-B subgraph build touched a remote halo row that is not (or no
+    longer) in the local cache. Raised only under the core lock by the
+    partition backend's graph (serve_backend.PartGraph): the fetch itself
+    must happen OUTSIDE the lock (graph.prefetch) so a remote round trip
+    can never stall concurrent predicts — the caller un-claims, re-runs
+    prefetch, and retries the build."""
 
 
 # ----------------------------------------------------------------------------
@@ -122,6 +132,26 @@ class DynamicGraph:
         for v in nodes:
             if not 0 <= v < self.n_nodes:
                 raise ValueError(f"node {v} out of range [0, {self.n_nodes})")
+
+    # -- the scorer-facing graph protocol (shared with the partition
+    # backend's PartGraph, which answers the same calls from a local shard
+    # plus a remote-halo cache) --
+
+    @property
+    def n_feat(self) -> int:
+        return self.feat.shape[1]
+
+    def feat_rows(self, ids: np.ndarray) -> np.ndarray:
+        return self.feat[ids]
+
+    def in_deg_of(self, ids: np.ndarray) -> np.ndarray:
+        return self.in_deg[ids]
+
+    def out_deg_of(self, ids: np.ndarray) -> np.ndarray:
+        return self.out_deg[ids]
+
+    def prefetch(self, targets: Iterable[int], hops: int):
+        """Single-host graph: every row is local — nothing to fetch."""
 
     def in_nbrs(self, v: int) -> list[int]:
         base = self._in_src[self._in_ptr[v]:self._in_ptr[v + 1]]
@@ -193,6 +223,49 @@ class DynamicGraph:
             frontier = nxt
         return depth
 
+    # -- compaction support: the mutated state as a msgpack-able pytree --
+
+    def mutation_state(self) -> dict:
+        """Everything a relaunch needs to reconstruct this graph's mutations
+        on top of the base CSR (which is rebuilt from the dataset): the
+        current features/degrees plus the appended edges in per-node
+        insertion order — in_nbrs()/out_nbrs() order (and thus tier-B
+        accumulation order) survives the round trip exactly."""
+        ein_v, ein_u = [], []
+        for v in sorted(self._extra_in):
+            for u in self._extra_in[v]:
+                ein_v.append(v)
+                ein_u.append(u)
+        eout_u, eout_v = [], []
+        for u in sorted(self._extra_out):
+            for v in self._extra_out[u]:
+                eout_u.append(u)
+                eout_v.append(v)
+        return {
+            "feat": self.feat.copy(),
+            "in_deg": self.in_deg.copy(),
+            "out_deg": self.out_deg.copy(),
+            "ein_v": np.asarray(ein_v, dtype=np.int64),
+            "ein_u": np.asarray(ein_u, dtype=np.int64),
+            "eout_u": np.asarray(eout_u, dtype=np.int64),
+            "eout_v": np.asarray(eout_v, dtype=np.int64),
+        }
+
+    def restore_mutations(self, state: dict):
+        """Inverse of mutation_state(), applied over a freshly-built base
+        graph (degrees/features are restored wholesale, not re-derived)."""
+        self.feat = np.array(state["feat"], dtype=np.float32, copy=True)
+        self.in_deg = np.array(state["in_deg"], dtype=np.int64, copy=True)
+        self.out_deg = np.array(state["out_deg"], dtype=np.int64, copy=True)
+        self._extra_in = {}
+        self._extra_out = {}
+        for v, u in zip(np.asarray(state["ein_v"]).tolist(),
+                        np.asarray(state["ein_u"]).tolist()):
+            self._extra_in.setdefault(int(v), []).append(int(u))
+        for u, v in zip(np.asarray(state["eout_u"]).tolist(),
+                        np.asarray(state["eout_v"]).tolist()):
+            self._extra_out.setdefault(int(u), []).append(int(v))
+
 
 # ----------------------------------------------------------------------------
 # tier-B engine: bucketed fresh-subgraph scoring
@@ -263,16 +336,16 @@ class SubgraphScorer:
         nb = _bucket(len(nodes), self.NODE_FLOOR)
         eb = _bucket(max(len(src_l), 1), self.EDGE_FLOOR)
         ids = np.asarray(nodes, dtype=np.int64)
-        feat = np.zeros((nb, graph.feat.shape[1]), dtype=np.float32)
-        feat[:len(nodes)] = graph.feat[ids]
+        feat = np.zeros((nb, graph.n_feat), dtype=np.float32)
+        feat[:len(nodes)] = graph.feat_rows(ids)
         src = np.zeros(eb, dtype=np.int32)
         dst = np.full(eb, nb, dtype=np.int32)          # trash row
         src[:len(src_l)] = src_l
         dst[:len(dst_l)] = dst_l
         in_norm = np.ones(nb, dtype=np.float32)
         out_norm = np.ones(nb, dtype=np.float32)
-        ind = graph.in_deg[ids].astype(np.float32)
-        outd = graph.out_deg[ids].astype(np.float32)
+        ind = graph.in_deg_of(ids).astype(np.float32)
+        outd = graph.out_deg_of(ids).astype(np.float32)
         if self.spec.model == "gcn":
             in_norm[:len(nodes)] = np.sqrt(ind)
             out_norm[:len(nodes)] = np.sqrt(outd)
@@ -385,14 +458,10 @@ class ServeCore:
     def __init__(self, cfg: Config, spec: ModelSpec, graph: DynamicGraph,
                  params, state, hidden: np.ndarray, logits: np.ndarray,
                  log=print, obs: Optional[obs_mod.Obs] = None):
-        if hidden.shape[0] != graph.n_nodes or logits.shape[0] != graph.n_nodes:
-            raise ConfigError(
-                f"embedding table rows ({hidden.shape[0]}/{logits.shape[0]}) "
-                f"!= graph nodes ({graph.n_nodes}) — wrong --embeddings "
-                f"artifact for this dataset?")
         self.cfg = cfg
         self.spec = spec
         self.graph = graph
+        self._check_table(hidden, logits)
         self.params = params
         self.state = state
         self.hidden = hidden
@@ -424,7 +493,32 @@ class ServeCore:
         # guarded-by: self._lock
         self.stats = {"requests": 0, "tier_a": 0, "tier_b": 0,
                       "refreshed_nodes": 0, "deltas": 0}
+        # delta-log compaction (--serve-compact-deltas): where the snapshot
+        # and tail log live (set by the CLI entry point; "" disables), the
+        # per-core artifact names (backends shard them per part/replica),
+        # the deltas-folded-into-snapshot count, and an overlap guard
+        self.serve_dir = ""
+        self._delta_log_name = DELTA_LOG
+        self._snapshot_name = SNAPSHOT
+        self._folded = 0            # guarded-by: self._lock
+        self._compacting = False    # guarded-by: self._lock
         self.batcher = _TierBBatcher(self._score_batch, cfg.serve_max_batch)
+
+    def _check_table(self, hidden: np.ndarray, logits: np.ndarray):
+        """Table rows must cover this core's graph — overridden by the
+        partition backend, whose table is a shard (n_own rows), not the
+        full node set."""
+        if (hidden.shape[0] != self.graph.n_nodes
+                or logits.shape[0] != self.graph.n_nodes):
+            raise ConfigError(
+                f"embedding table rows ({hidden.shape[0]}/{logits.shape[0]}) "
+                f"!= graph nodes ({self.graph.n_nodes}) — wrong --embeddings "
+                f"artifact for this dataset?")
+
+    def _row(self, node: int) -> int:
+        """Table row index for a global node id (identity here; the
+        partition backend maps global id -> local shard row)."""
+        return node
 
     # -- scoring --
 
@@ -437,12 +531,31 @@ class ServeCore:
         re-dirtied while the step ran — a newer delta's mark always wins
         over a stale result. Clean targets are never written back: the
         table row stays the precompute's full-eval output (tier A's
-        bitwise contract)."""
-        with self._lock:
-            was_dirty = [t for t in targets if t in self.dirty]
-            self.dirty.difference_update(was_dirty)
-            self._refreshing.update(was_dirty)
-            arrays = self.scorer.build_arrays(self.graph, targets)
+        bitwise contract).
+
+        The halo dance (partition backends only; no-ops on a single-host
+        graph): remote rows the closure needs are fetched OUTSIDE the lock
+        (graph.prefetch — peer round trips must never stall concurrent
+        predicts, and peers answer `resolve` under only their own short
+        lock, so no distributed lock cycle can form). The locked build is
+        then cache-only; a delta invalidating a cached row between
+        prefetch and build raises HaloCacheMiss and the claim/prefetch/
+        build is retried."""
+        for attempt in range(4):
+            self.graph.prefetch(targets, self.hops)
+            with self._lock:
+                was_dirty = [t for t in targets if t in self.dirty]
+                self.dirty.difference_update(was_dirty)
+                self._refreshing.update(was_dirty)
+                try:
+                    arrays = self.scorer.build_arrays(self.graph, targets)
+                except HaloCacheMiss:
+                    self._refreshing.difference_update(was_dirty)
+                    self.dirty.update(was_dirty)
+                    if attempt == 3:
+                        raise
+                    continue
+            break
         try:
             results = self.scorer.run_arrays(self.params, self.state,
                                              targets, arrays)
@@ -458,8 +571,8 @@ class ServeCore:
                 if t in self.dirty:         # re-dirtied mid-step: stale, skip
                     continue
                 hid, lg = results[t]
-                self.hidden[t] = hid
-                self.logits[t] = lg
+                self.hidden[self._row(t)] = hid
+                self.logits[self._row(t)] = lg
                 self.stats["refreshed_nodes"] += 1
                 since = self._dirty_since.pop(t, None)
                 if since is not None:
@@ -481,7 +594,7 @@ class ServeCore:
         if tier == "A" or (tier is None and not is_dirty):
             with self._lock:
                 self.stats["tier_a"] += 1
-                scores = self.logits[node].copy()
+                scores = self.logits[self._row(node)].copy()
             out = {"ok": True, "node": node, "tier": "A",
                    "scores": scores.tolist()}
             if is_dirty:
@@ -534,7 +647,7 @@ class ServeCore:
             else:
                 with self._lock:
                     self.stats["tier_a"] += 1
-                    scores = self.logits[n].copy()
+                    scores = self.logits[self._row(n)].copy()
                 r = {"ok": True, "node": n, "tier": "A",
                      "scores": scores.tolist()}
                 if n in stale:
@@ -628,11 +741,11 @@ class ServeCore:
     # -- resumable delta log --
 
     def flush_delta_log(self, serve_dir: str) -> str:
-        """Atomically persist every ingested delta as JSONL (the dirty
-        frontier is derivable by replay, so the log alone resumes the
-        server's exact state on relaunch)."""
+        """Atomically persist every un-compacted delta as JSONL (snapshot +
+        this log resumes the server's exact state on relaunch; with
+        compaction off the log alone is the full history)."""
         os.makedirs(serve_dir, exist_ok=True)
-        path = os.path.join(serve_dir, DELTA_LOG)
+        path = os.path.join(serve_dir, self._delta_log_name)
         with self._lock:
             lines = [json.dumps(d) for d in self.deltas]
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -643,11 +756,19 @@ class ServeCore:
         os.replace(tmp, path)
         return path
 
+    def _apply_logged(self, d: dict):
+        """Re-ingest one logged delta (the partition backend extends the
+        op set with its fan-out entries)."""
+        if d["op"] == "add_edges":
+            self.add_edges(d["edges"])
+        elif d["op"] == "update_feat":
+            self.update_feat(d["node"], d["feat"])
+
     def replay_delta_log(self, serve_dir: str) -> int:
         """Re-ingest a previous run's flushed deltas (marks the dirty
         frontier again; the background refresh re-scores it). Returns the
         number of deltas replayed."""
-        path = os.path.join(serve_dir, DELTA_LOG)
+        path = os.path.join(serve_dir, self._delta_log_name)
         if not os.path.exists(path):
             return 0
         n = 0
@@ -656,13 +777,89 @@ class ServeCore:
                 line = line.strip()
                 if not line:
                     continue
-                d = json.loads(line)
-                if d["op"] == "add_edges":
-                    self.add_edges(d["edges"])
-                elif d["op"] == "update_feat":
-                    self.update_feat(d["node"], d["feat"])
+                self._apply_logged(json.loads(line))
                 n += 1
         return n
+
+    # -- delta-log compaction (--serve-compact-deltas) --
+
+    def maybe_compact(self):
+        """Fold the delta log into an integrity-headed snapshot once it
+        crosses the configured threshold, so a relaunch replays only the
+        tail instead of every delta ever ingested. Called on the delta
+        ingestion path (the ingesting client pays the snapshot write;
+        concurrent predicts keep running — the blob write happens outside
+        the core lock)."""
+        if self.cfg.serve_compact_deltas <= 0 or not self.serve_dir:
+            return
+        with self._lock:
+            if (self._compacting
+                    or len(self.deltas) < self.cfg.serve_compact_deltas):
+                return
+            self._compacting = True
+        try:
+            self.compact(self.serve_dir)
+        finally:
+            with self._lock:
+                self._compacting = False
+
+    def compact(self, serve_dir: str) -> dict:
+        """Snapshot the mutated graph + tables + dirty frontier (write_blob:
+        magic + sha256, fsync-before-rename) and truncate the log to the
+        deltas that arrived after the snapshot point."""
+        os.makedirs(serve_dir, exist_ok=True)
+        with self._lock:
+            k = len(self.deltas)
+            payload = self.graph.mutation_state()
+            payload["hidden"] = self.hidden.copy()
+            payload["logits"] = self.logits.copy()
+            payload["dirty"] = np.asarray(
+                sorted(self.dirty | self._refreshing), dtype=np.int64)
+            payload["n_deltas"] = int(self._folded + k)
+        ckpt.write_blob(os.path.join(serve_dir, self._snapshot_name), payload)
+        with self._lock:
+            # deltas that landed while the blob was writing stay in the tail
+            # (graph state + first-k deltas were captured under one lock
+            # hold, so snapshot + tail is exactly the full history)
+            del self.deltas[:k]
+            self._folded += k
+            tail = len(self.deltas)
+        self.flush_delta_log(serve_dir)
+        out = {"folded": k, "tail": tail}
+        name = self._snapshot_name
+        if self.obs is not None:
+            self.obs.emit("serve_compact", **out)
+        self.log(f"[serve] compacted delta log: {k} delta(s) folded into "
+                 f"{name}, {tail} left in the tail")
+        return out
+
+    def load_serving_state(self, serve_dir: str) -> dict:
+        """Relaunch path: adopt the compaction snapshot if one exists
+        (mutated graph + tables + dirty frontier — O(snapshot)), then
+        replay the tail log. A corrupt snapshot raises CheckpointCorrupt
+        loudly: the log is only a tail, so silently skipping the snapshot
+        would resume from a hole in history."""
+        snap = os.path.join(serve_dir, self._snapshot_name)
+        folded = 0
+        if os.path.exists(snap):
+            payload = ckpt.read_blob(snap)
+            hidden = np.array(payload["hidden"], copy=True)
+            logits = np.array(payload["logits"], copy=True)
+            self.graph.restore_mutations(payload)
+            self._check_table(hidden, logits)
+            folded = int(payload["n_deltas"])
+            with self._lock:
+                self.hidden = hidden
+                self.logits = logits
+                dirty = set(np.asarray(payload["dirty"]).tolist())
+                self.dirty |= dirty
+                self._mark_dirty_stamps_locked(dirty)
+                self._folded = folded
+                self.stats["deltas"] += folded
+            self.log(f"[serve] snapshot {self._snapshot_name}: "
+                     f"{folded} folded delta(s), {len(dirty)} node(s) dirty")
+        return {"folded": folded,
+                "replayed": self.replay_delta_log(serve_dir)}
 
     def snapshot_stats(self) -> dict:
         with self._lock:
@@ -733,45 +930,54 @@ class ServeServer:
                 return {"ok": False, "err": "draining"}
             self._inflight += 1
         try:
-            if op == "ping":
-                return {"ok": True}
-            if op == "predict":
-                return self.core.predict(req["node"], tier=req.get("tier"))
-            if op == "predict_many":
-                return {"ok": True,
-                        "results": self.core.predict_many(
-                            req["nodes"], tier=req.get("tier"))}
-            if op == "add_edges":
-                return self.core.add_edges(req["edges"])
-            if op == "update_feat":
-                return self.core.update_feat(req["node"], req["feat"])
-            if op == "dirty":
-                # include in-flight refresh claims: a claimed node is still
-                # stale in the table (same accounting as snapshot_stats) —
-                # dirty == 0 must mean "every row is fresh", not "the
-                # background refresher happens to hold the last few"
-                with self.core._lock:
-                    n = len(self.core.dirty) + len(self.core._refreshing)
-                return {"ok": True, "count": n}
-            if op == "flush":
-                return {"ok": True, "refreshed": self.core.flush()}
-            if op == "stats":
-                return {"ok": True, **self.core.snapshot_stats()}
-            if op == "metrics":
-                # the full registry snapshot (counters, gauges, histograms
-                # incl. per-tier p50/p90/p99) — the machine-readable twin
-                # of 'stats' for dashboards/scrapers
-                self.core.snapshot_stats()      # refresh the gauges first
-                return {"ok": True, "metrics": self.core.registry.snapshot()}
-            if op == "shutdown":
-                self.shutdown_requested.set()
-                return {"ok": True}
-            return {"ok": False, "err": f"unknown op {op!r}"}
+            return self._dispatch(op, req)
         except (KeyError, ValueError, TypeError) as ex:
             return {"ok": False, "err": f"{type(ex).__name__}: {ex}"}
         finally:
             with self._lock:
                 self._inflight -= 1
+
+    def _dispatch(self, op: Optional[str], req: dict) -> dict:
+        """One op -> one response dict (subclassed by the partition
+        backend's server, which extends the op set)."""
+        if op == "ping":
+            return {"ok": True}
+        if op == "predict":
+            return self.core.predict(req["node"], tier=req.get("tier"))
+        if op == "predict_many":
+            return {"ok": True,
+                    "results": self.core.predict_many(
+                        req["nodes"], tier=req.get("tier"))}
+        if op == "add_edges":
+            out = self.core.add_edges(req["edges"])
+            self.core.maybe_compact()
+            return out
+        if op == "update_feat":
+            out = self.core.update_feat(req["node"], req["feat"])
+            self.core.maybe_compact()
+            return out
+        if op == "dirty":
+            # include in-flight refresh claims: a claimed node is still
+            # stale in the table (same accounting as snapshot_stats) —
+            # dirty == 0 must mean "every row is fresh", not "the
+            # background refresher happens to hold the last few"
+            with self.core._lock:
+                n = len(self.core.dirty) + len(self.core._refreshing)
+            return {"ok": True, "count": n}
+        if op == "flush":
+            return {"ok": True, "refreshed": self.core.flush()}
+        if op == "stats":
+            return {"ok": True, **self.core.snapshot_stats()}
+        if op == "metrics":
+            # the full registry snapshot (counters, gauges, histograms
+            # incl. per-tier p50/p90/p99) — the machine-readable twin
+            # of 'stats' for dashboards/scrapers
+            self.core.snapshot_stats()      # refresh the gauges first
+            return {"ok": True, "metrics": self.core.registry.snapshot()}
+        if op == "shutdown":
+            self.shutdown_requested.set()
+            return {"ok": True}
+        return {"ok": False, "err": f"unknown op {op!r}"}
 
     def drain(self, timeout_s: float = 30.0):
         """Stop accepting new work, wait for in-flight handlers, stop the
@@ -877,10 +1083,19 @@ def serve_main(argv=None) -> int:
         sys.exit(2)
 
     serve_dir = cfg.serve_dir or os.path.join(cfg.ckpt_path, "serve")
-    replayed = core.replay_delta_log(serve_dir)
-    if replayed:
-        log(f"[serve] replayed {replayed} delta(s) from the previous run's "
-            f"log ({len(core.dirty)} nodes dirty, refreshing in background)")
+    core.serve_dir = serve_dir
+    try:
+        counts = core.load_serving_state(serve_dir)
+    except ckpt.CheckpointCorrupt as ex:
+        print(f"[config] serving snapshot unusable: {ex} — the delta log is "
+              f"only a tail past a snapshot; refusing to resume from a hole "
+              f"in history", file=sys.stderr)
+        sys.exit(2)
+    replayed = counts["replayed"]
+    if replayed or counts["folded"]:
+        log(f"[serve] resumed: {counts['folded']} delta(s) from the "
+            f"snapshot + {replayed} replayed from the tail log "
+            f"({len(core.dirty)} nodes dirty, refreshing in background)")
 
     signals = resilience.PreemptSignals(
         action="drain in-flight requests and flush the delta log",
@@ -907,7 +1122,8 @@ def serve_main(argv=None) -> int:
     if obs is not None:
         obs.emit("serve_header", port=server.port, n_nodes=core.graph.n_nodes,
                  model=cfg.model, hops=core.hops,
-                 max_batch=cfg.serve_max_batch, replayed=replayed)
+                 max_batch=cfg.serve_max_batch, replayed=replayed,
+                 folded=counts["folded"])
     try:
         while signals.requested is None:
             if server.shutdown_requested.wait(0.05):

@@ -1,0 +1,782 @@
+"""Partition-sharded serving, backend half: one process per (part, replica)
+owning exactly its training-partition shard of the serving state.
+
+Each backend holds: the embedding-table rows of its part's nodes (the
+global -> shard-row map comes from the same `global_nid` tables the
+training halo exchange indexes by), the subgraph CSRs restricted to
+edges it owns a side of, its slice of the delta journal, and a cache of
+remote boundary rows. Tier A is a local shard lookup. Tier B builds the
+exact L-hop closure: rows the closure needs from OTHER parts are resolved
+through the halo machinery — fetched batched per remote part (peer
+`resolve` op over pooled connections), cached, and dropped when the owner
+mutates them (the router's `invalidate` fan-out), so the closure's inputs
+are always the owners' current state and the scores stay bitwise equal to
+the single-host server's.
+
+Exactness, in two invariants:
+
+  * CSR restriction preserves order — the in-CSR keeps only edges whose
+    destination is owned, via an order-preserving filter + stable sort, so
+    every destination's in-edge order (and thus its padded-SpMM
+    accumulation order and score) is identical to the single-host
+    DynamicGraph's.
+  * Deltas land pre-routed — the router serializes writes and replies only
+    after apply + invalidate + mark have all landed, so any read that
+    follows a write observes the same ordering one lock hold gives the
+    single-host core.
+
+Locking: graph shard state (feat/degree/CSR/append lists) is protected by
+the owning core's lock, exactly like DynamicGraph. Only the halo cache has
+its own lock — `prefetch` runs OUTSIDE the core lock (a peer round trip
+must never stall concurrent predicts; peers answer `resolve` under only
+their own short lock, so no distributed lock cycle can form), and the
+locked build is cache-only, raising serve.HaloCacheMiss to trigger a
+refetch when a delta races the prefetch.
+
+CLI:  python -m bnsgcn_tpu.main serve-backend --dataset ... \
+          --serve-part 0 [--serve-replica 0] [--serve-backend-port 0] \
+          [--serve-router host:port] --ckpt-path ...
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Iterable, Optional
+
+import numpy as np
+
+from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu import obs as obs_mod
+from bnsgcn_tpu import resilience
+from bnsgcn_tpu import serve
+from bnsgcn_tpu.config import Config, ConfigError, parse_config
+from bnsgcn_tpu.data.graph import Graph
+from bnsgcn_tpu.evaluate import full_graph_embeddings
+from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
+from bnsgcn_tpu.parallel import coord as coord_mod
+from bnsgcn_tpu.serve_router import (artifacts_dir, load_owner_map,
+                                     router_endpoint)
+
+
+# ----------------------------------------------------------------------------
+# the shard graph: owned CSR slices + remote-halo cache
+# ----------------------------------------------------------------------------
+
+class PartGraph:
+    """serve.DynamicGraph's protocol over one partition shard. All ids in
+    and out are GLOBAL node ids; storage is shard-local ([n_own] arrays
+    indexed through own_ids). Remote rows come from the halo cache, filled
+    by `prefetch` through the installed `resolver` callable."""
+
+    def __init__(self, g: Graph, owner: np.ndarray, part: int):
+        if owner.shape[0] != g.n_nodes:
+            raise ConfigError(
+                f"owner map covers {owner.shape[0]} nodes but the serving "
+                f"graph has {g.n_nodes} — artifacts from another dataset/"
+                f"mode (inductive artifacts cannot back distributed "
+                f"serving of the full graph)")
+        self.n_nodes = g.n_nodes
+        self.owner = np.asarray(owner, dtype=np.int32)
+        self.part = int(part)
+        self.own_ids = np.flatnonzero(self.owner == self.part
+                                      ).astype(np.int64)     # sorted
+        self.n_own = int(self.own_ids.shape[0])
+        if self.n_own == 0:
+            raise ConfigError(f"part {part} owns no nodes")
+        self.feat = np.array(np.asarray(g.feat)[self.own_ids],
+                             dtype=np.float32, copy=True)
+        self.in_deg = g.in_degrees().astype(np.int64)[self.own_ids].copy()
+        self.out_deg = g.out_degrees().astype(np.int64)[self.own_ids].copy()
+        src = np.asarray(g.src)
+        dst = np.asarray(g.dst)
+        # in-CSR over OWNED destinations, src kept global: the order-
+        # preserving keep-filter + stable sort leave each destination's
+        # in-edge order exactly as the single-host DynamicGraph builds it,
+        # which is what makes tier-B scores bitwise identical
+        keep = self.owner[dst] == self.part
+        s, d = src[keep], dst[keep]
+        order = np.argsort(d, kind="stable")
+        self._in_src = s[order].astype(np.int64)
+        self._in_ptr = np.searchsorted(
+            np.searchsorted(self.own_ids, d[order]),
+            np.arange(self.n_own + 1))
+        # out-CSR over OWNED sources, dst kept global (dirty-mark BFS)
+        keep = self.owner[src] == self.part
+        s, d = src[keep], dst[keep]
+        order = np.argsort(s, kind="stable")
+        self._out_dst = d[order].astype(np.int64)
+        self._out_ptr = np.searchsorted(
+            np.searchsorted(self.own_ids, s[order]),
+            np.arange(self.n_own + 1))
+        self._extra_in: dict[int, list[int]] = {}    # owned v -> [global u]
+        self._extra_out: dict[int, list[int]] = {}   # owned u -> [global v]
+        # (part, ids) -> {gid: row dict}; installed by the CLI once the
+        # fleet map is known — None means remote rows cannot resolve
+        self.resolver = None
+        self._halo: dict[int, dict] = {}    # guarded-by: self._hlock
+        self._hlock = threading.Lock()
+        self.halo_fetches = 0               # guarded-by: self._hlock
+        self.halo_hits = 0                  # guarded-by: self._hlock
+
+    # -- id mapping --
+
+    def _check(self, *nodes: int):
+        for v in nodes:
+            if not 0 <= v < self.n_nodes:
+                raise ValueError(f"node {v} out of range [0, {self.n_nodes})")
+
+    def owns(self, v: int) -> bool:
+        return int(self.owner[v]) == self.part
+
+    def local_of(self, v: int) -> int:
+        """Shard row of an owned global id (named error on a mis-route)."""
+        i = int(np.searchsorted(self.own_ids, v))
+        if i >= self.n_own or self.own_ids[i] != v:
+            raise ValueError(f"node {v} is owned by part "
+                             f"{int(self.owner[v])}, not part {self.part} — "
+                             f"mis-routed request?")
+        return i
+
+    def _halo_row(self, v: int) -> dict:
+        with self._hlock:
+            row = self._halo.get(v)
+        if row is None:
+            raise serve.HaloCacheMiss(
+                f"part {self.part}: remote row {v} (owner part "
+                f"{int(self.owner[v])}) not in the halo cache")
+        return row
+
+    # -- the scorer-facing protocol (global ids, owned or cached-remote) --
+
+    @property
+    def n_feat(self) -> int:
+        return self.feat.shape[1]
+
+    def feat_rows(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.n_feat), dtype=np.float32)
+        for i, v in enumerate(np.asarray(ids).tolist()):
+            if self.owns(v):
+                out[i] = self.feat[self.local_of(v)]
+            else:
+                out[i] = self._halo_row(v)["feat"]
+        return out
+
+    def in_deg_of(self, ids: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            [int(self.in_deg[self.local_of(v)]) if self.owns(v)
+             else self._halo_row(v)["in_deg"]
+             for v in np.asarray(ids).tolist()], dtype=np.int64)
+
+    def out_deg_of(self, ids: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            [int(self.out_deg[self.local_of(v)]) if self.owns(v)
+             else self._halo_row(v)["out_deg"]
+             for v in np.asarray(ids).tolist()], dtype=np.int64)
+
+    def in_nbrs(self, v: int) -> list[int]:
+        if self.owns(v):
+            lv = self.local_of(v)
+            base = self._in_src[self._in_ptr[lv]:self._in_ptr[lv + 1]]
+            extra = self._extra_in.get(v)
+            return base.tolist() + extra if extra else base.tolist()
+        return list(self._halo_row(v)["in"])
+
+    def out_nbrs(self, v: int) -> list[int]:
+        lv = self.local_of(v)       # BFS only ever expands owned nodes
+        base = self._out_dst[self._out_ptr[lv]:self._out_ptr[lv + 1]]
+        extra = self._extra_out.get(v)
+        return base.tolist() + extra if extra else base.tolist()
+
+    def in_closure(self, targets: Iterable[int], hops: int) -> dict[int, int]:
+        """Same walk as DynamicGraph.in_closure, but cache-only for remote
+        nodes: a missing halo row raises HaloCacheMiss (the caller
+        prefetches outside the lock and retries)."""
+        depth = {int(t): 0 for t in targets}
+        frontier = list(depth)
+        for d in range(1, hops + 1):
+            nxt = []
+            for v in frontier:
+                for u in self.in_nbrs(v):
+                    if u not in depth:
+                        depth[u] = d
+                        nxt.append(u)
+            frontier = nxt
+        return depth
+
+    # -- halo fetch/invalidate (prefetch runs OUTSIDE the core lock) --
+
+    def prefetch(self, targets: Iterable[int], hops: int):
+        """Fetch every remote row the closure of `targets` can touch,
+        batched per remote part per BFS level (plus the leaf level, whose
+        rows feed feat/degree lookups even though their in-lists do not
+        expand). Local topology is read un-locked here — any raced delta
+        only changes WHICH rows get prefetched; the locked build re-walks
+        exactly and a then-missing row raises HaloCacheMiss, which retries
+        through here."""
+        if self.resolver is None:
+            return
+        seen = {int(t) for t in targets}
+        frontier = list(seen)
+        for _ in range(int(hops)):
+            self._fetch_missing([v for v in frontier if not self.owns(v)])
+            nxt = []
+            for v in frontier:
+                for u in self.in_nbrs(v):
+                    if u not in seen:
+                        seen.add(u)
+                        nxt.append(u)
+            frontier = nxt
+        self._fetch_missing([v for v in frontier if not self.owns(v)])
+
+    def _fetch_missing(self, nodes: list[int]):
+        need = []
+        with self._hlock:
+            for v in nodes:
+                if v in self._halo:
+                    self.halo_hits += 1
+                else:
+                    need.append(v)
+        if not need:
+            return
+        by_part: dict[int, list[int]] = {}
+        for v in need:
+            by_part.setdefault(int(self.owner[v]), []).append(v)
+        for p, ids in sorted(by_part.items()):
+            rows = self.resolver(p, sorted(set(ids)))
+            with self._hlock:
+                self.halo_fetches += len(rows)
+                self._halo.update(rows)
+
+    def invalidate(self, nodes: Iterable[int]) -> int:
+        """Drop cached remote rows the router reports as mutated; returns
+        how many were actually cached here."""
+        n = 0
+        with self._hlock:
+            for v in nodes:
+                if self._halo.pop(int(v), None) is not None:
+                    n += 1
+        return n
+
+    def halo_stats(self) -> dict:
+        with self._hlock:
+            return {"halo_cached": len(self._halo),
+                    "halo_fetches": self.halo_fetches,
+                    "halo_hits": self.halo_hits}
+
+    # -- owner-side delta application + export --
+
+    def add_edges(self, edges: Iterable[tuple[int, int]]) -> set[int]:
+        """Apply the locally-owned halves of a router-fanned edge delta:
+        the in-edge + in-degree land iff this part owns v, the out-edge +
+        out-degree iff it owns u. Returns the owned touched nodes."""
+        touched: set[int] = set()
+        for u, v in edges:
+            u, v = int(u), int(v)
+            self._check(u, v)
+            if self.owns(u):
+                self._extra_out.setdefault(u, []).append(v)
+                self.out_deg[self.local_of(u)] += 1
+                touched.add(u)
+            if self.owns(v):
+                self._extra_in.setdefault(v, []).append(u)
+                self.in_deg[self.local_of(v)] += 1
+                touched.add(v)
+        return touched
+
+    def set_feat(self, v: int, vec) -> set[int]:
+        v = int(v)
+        self._check(v)
+        lv = self.local_of(v)
+        vec = np.asarray(vec, dtype=np.float32)
+        if vec.shape != self.feat[lv].shape:
+            raise ValueError(f"feature length {vec.shape} != "
+                             f"{self.feat[lv].shape}")
+        self.feat[lv] = vec
+        return {v}
+
+    def export_rows(self, nodes: Iterable[int]) -> dict:
+        """The halo payload peers cache: current feature row, degrees and
+        in-neighbor list of OWNED nodes, JSON-ready (string keys; float32
+        values survive the float64 JSON round trip exactly). Caller holds
+        the core lock — this is the resolve handler's short critical
+        section."""
+        rows: dict[str, dict] = {}
+        for v in nodes:
+            v = int(v)
+            lv = self.local_of(v)
+            rows[str(v)] = {
+                "feat": self.feat[lv].tolist(),
+                "in_deg": int(self.in_deg[lv]),
+                "out_deg": int(self.out_deg[lv]),
+                "in": [int(u) for u in self.in_nbrs(v)],
+            }
+        return rows
+
+    # -- compaction support (same contract as DynamicGraph) --
+
+    def mutation_state(self) -> dict:
+        ein_v, ein_u = [], []
+        for v in sorted(self._extra_in):
+            for u in self._extra_in[v]:
+                ein_v.append(v)
+                ein_u.append(u)
+        eout_u, eout_v = [], []
+        for u in sorted(self._extra_out):
+            for v in self._extra_out[u]:
+                eout_u.append(u)
+                eout_v.append(v)
+        return {
+            "feat": self.feat.copy(),
+            "in_deg": self.in_deg.copy(),
+            "out_deg": self.out_deg.copy(),
+            "ein_v": np.asarray(ein_v, dtype=np.int64),
+            "ein_u": np.asarray(ein_u, dtype=np.int64),
+            "eout_u": np.asarray(eout_u, dtype=np.int64),
+            "eout_v": np.asarray(eout_v, dtype=np.int64),
+        }
+
+    def restore_mutations(self, state: dict):
+        feat = np.array(state["feat"], dtype=np.float32, copy=True)
+        if feat.shape != self.feat.shape:
+            raise ConfigError(
+                f"snapshot shard shape {feat.shape} != part {self.part} "
+                f"shard {self.feat.shape} — snapshot from another "
+                f"partitioning?")
+        self.feat = feat
+        self.in_deg = np.array(state["in_deg"], dtype=np.int64, copy=True)
+        self.out_deg = np.array(state["out_deg"], dtype=np.int64, copy=True)
+        self._extra_in = {}
+        self._extra_out = {}
+        for v, u in zip(np.asarray(state["ein_v"]).tolist(),
+                        np.asarray(state["ein_u"]).tolist()):
+            self._extra_in.setdefault(int(v), []).append(int(u))
+        for u, v in zip(np.asarray(state["eout_u"]).tolist(),
+                        np.asarray(state["eout_v"]).tolist()):
+            self._extra_out.setdefault(int(u), []).append(int(v))
+
+
+# ----------------------------------------------------------------------------
+# the backend core: shard table + pre-routed delta ops
+# ----------------------------------------------------------------------------
+
+class BackendCore(serve.ServeCore):
+    """serve.ServeCore over one PartGraph: the table holds only owned rows
+    (global id -> shard row through _row), client-facing deltas are
+    rejected (they must route), and the pre-routed fan-out ops
+    (apply_delta / apply_feat / mark / invalidate / resolve) plus a
+    per-(part, replica) delta-log shard replace them."""
+
+    def __init__(self, cfg: Config, spec: ModelSpec, graph: PartGraph,
+                 params, state, hidden: np.ndarray, logits: np.ndarray,
+                 log=print, obs: Optional[obs_mod.Obs] = None):
+        super().__init__(cfg, spec, graph, params, state, hidden, logits,
+                         log=log, obs=obs)
+        self.part = graph.part
+        self.replica = int(cfg.serve_replica)
+        self.backend_id = f"p{self.part}.r{self.replica}"
+        # per-(part, replica) shards: two replicas of one part sharing a
+        # serve_dir must never race on one file
+        self._delta_log_name = f"delta_log.{self.backend_id}.jsonl"
+        self._snapshot_name = f"serve_snapshot.{self.backend_id}.blob"
+
+    def _check_table(self, hidden: np.ndarray, logits: np.ndarray):
+        n_own = self.graph.n_own
+        if hidden.shape[0] != n_own or logits.shape[0] != n_own:
+            raise ConfigError(
+                f"table shard rows ({hidden.shape[0]}/{logits.shape[0]}) != "
+                f"part {self.graph.part} owned nodes ({n_own}) — wrong "
+                f"--embeddings artifact or partitioning?")
+
+    def _row(self, node: int) -> int:
+        return self.graph.local_of(int(node))
+
+    # client-facing deltas must route: the owning parts, the halo
+    # invalidation and the cross-part dirty mark are the ROUTER's job
+    def add_edges(self, edges: list) -> dict:
+        raise ValueError(
+            "add_edges must route through the serve-router (backends only "
+            "accept the pre-routed apply_delta/mark/invalidate fan-out)")
+
+    def update_feat(self, node: int, vec) -> dict:
+        raise ValueError(
+            "update_feat must route through the serve-router (backends "
+            "only accept the pre-routed apply_feat/mark/invalidate fan-out)")
+
+    # -- pre-routed fan-out ops --
+
+    def apply_delta(self, edges: list) -> dict:
+        """Phase 1 of a routed add_edges: append the halves this part owns
+        and journal the entry (replay re-applies exactly this)."""
+        pairs = [(int(u), int(v)) for u, v in edges]
+        with self._lock:
+            touched = self.graph.add_edges(pairs)
+            self.deltas.append({"op": "apply_delta",
+                                "edges": [[u, v] for u, v in pairs]})
+            self.stats["deltas"] += 1
+        if self.obs is not None:
+            self.obs.emit("delta", op="apply_delta", edges=len(pairs),
+                          part=self.part, touched=len(touched))
+        return {"ok": True, "touched": len(touched)}
+
+    def apply_feat(self, node: int, vec) -> dict:
+        with self._lock:
+            self.graph.set_feat(int(node), vec)
+            self.deltas.append({"op": "apply_feat", "node": int(node),
+                                "feat": np.asarray(
+                                    vec, dtype=np.float32).tolist()})
+            self.stats["deltas"] += 1
+        if self.obs is not None:
+            self.obs.emit("delta", op="apply_feat", node=int(node),
+                          part=self.part)
+        return {"ok": True}
+
+    def mark_nodes(self, seeds: list) -> dict:
+        """One shard's slice of the router's distributed dirty-mark BFS:
+        walk owned out-edges with the remaining hop budget, mark every
+        owned node reached (its logits can have changed), and hand nodes
+        owned elsewhere back as the frontier. Journaled, so a relaunch
+        replays its own dirty marks without any cross-part traffic."""
+        pairs = [(int(v), int(h)) for v, h in seeds]
+        remote: dict[int, int] = {}
+        with self._lock:
+            best: dict[int, int] = {}
+            stack = list(pairs)
+            reached: set[int] = set()
+            while stack:
+                v, h = stack.pop()
+                if best.get(v, -1) >= h:
+                    continue
+                best[v] = h
+                if not self.graph.owns(v):
+                    if remote.get(v, -1) < h:
+                        remote[v] = h
+                    continue
+                reached.add(v)
+                if h > 0:
+                    for w in self.graph.out_nbrs(v):
+                        stack.append((w, h - 1))
+            added = reached - self.dirty
+            self.dirty |= reached
+            self._mark_dirty_stamps_locked(reached)
+            self.deltas.append({"op": "mark", "nodes": [[v, h]
+                                                        for v, h in pairs]})
+            self.stats["deltas"] += 1
+            dirty_total = len(self.dirty)
+        return {"ok": True, "marked": len(added), "dirty_total": dirty_total,
+                "frontier": sorted([v, h] for v, h in remote.items())}
+
+    def invalidate(self, nodes: list) -> dict:
+        """Phase 2 of a routed delta: drop mutated remote rows from the
+        halo cache. Not journaled — a relaunch starts with an empty cache,
+        so there is nothing stale to drop."""
+        return {"ok": True, "dropped": self.graph.invalidate(nodes)}
+
+    def resolve(self, nodes: list) -> dict:
+        """Peer-facing halo lookup: the current rows of OWNED nodes, under
+        one short lock hold (this is the only cross-backend read path, and
+        it never takes another lock — no distributed lock cycle)."""
+        with self._lock:
+            return {"ok": True, "part": self.part,
+                    "rows": self.graph.export_rows(nodes)}
+
+    def _apply_logged(self, d: dict):
+        if d["op"] == "apply_delta":
+            self.apply_delta(d["edges"])
+        elif d["op"] == "apply_feat":
+            self.apply_feat(d["node"], d["feat"])
+        elif d["op"] == "mark":
+            self.mark_nodes(d["nodes"])
+        else:
+            super()._apply_logged(d)
+
+    def snapshot_stats(self) -> dict:
+        out = super().snapshot_stats()
+        out["part"] = self.part
+        out["replica"] = self.replica
+        out["backend"] = self.backend_id
+        out["n_own"] = self.graph.n_own
+        out.update(self.graph.halo_stats())
+        return out
+
+
+class BackendServer(serve.ServeServer):
+    """serve.ServeServer plus the fan-out/peer op set; client-facing delta
+    ops come back as named route-through-the-router errors (BackendCore
+    raises, the base dispatcher's error path answers)."""
+
+    def _dispatch(self, op: Optional[str], req: dict) -> dict:
+        core = self.core
+        if op == "apply_delta":
+            out = core.apply_delta(req["edges"])
+            core.maybe_compact()
+            return out
+        if op == "apply_feat":
+            out = core.apply_feat(req["node"], req["feat"])
+            core.maybe_compact()
+            return out
+        if op == "mark":
+            out = core.mark_nodes(req["nodes"])
+            core.maybe_compact()
+            return out
+        if op == "invalidate":
+            return core.invalidate(req["nodes"])
+        if op == "resolve":
+            return core.resolve(req["nodes"])
+        if op == "part_info":
+            return {"ok": True, "part": core.part, "replica": core.replica,
+                    "n_own": core.graph.n_own, "n_nodes": core.graph.n_nodes}
+        return super()._dispatch(op, req)
+
+
+# ----------------------------------------------------------------------------
+# peer resolver: halo rows through the fleet map
+# ----------------------------------------------------------------------------
+
+class PeerResolver:
+    """Resolves remote halo rows for a PartGraph: asks the router where
+    each part lives (cached), keeps one pooled connection per peer, and on
+    a dead peer refreshes the fleet map and retries once — `resolve` is
+    idempotent, so pooled retry-once delivery is safe."""
+
+    def __init__(self, router_addr: str, router_port: int,
+                 timeout_s: float = 30.0):
+        self.router_addr = router_addr
+        self.router_port = int(router_port)
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._clients: dict = {}    # guarded-by: self._lock
+
+    def _client(self, part: int) -> coord_mod.LineJsonClient:
+        with self._lock:
+            c = self._clients.get(part)
+        if c is not None:
+            return c
+        resp = coord_mod.rpc_line_json(
+            self.router_addr, self.router_port, {"op": "fleet"},
+            time.monotonic() + self.timeout_s, what="serve router")
+        entries = (resp.get("parts") or {}).get(str(part)) or []
+        if not entries:
+            raise coord_mod.CoordTimeout(
+                f"no backend registered for part {part} — halo rows it "
+                f"owns cannot resolve")
+        e = entries[0]
+        c = coord_mod.LineJsonClient(e["addr"], int(e["port"]),
+                                     timeout_s=self.timeout_s,
+                                     what=f"peer backend {e['id']}")
+        with self._lock:
+            self._clients[part] = c
+        return c
+
+    def __call__(self, part: int, ids: list[int]) -> dict:
+        for attempt in (0, 1):
+            client = self._client(part)
+            try:
+                resp = client.request({"op": "resolve",
+                                       "nodes": [int(v) for v in ids]})
+            except coord_mod.CoordTimeout:
+                with self._lock:        # stale map: refetch + retry once
+                    self._clients.pop(part, None)
+                if attempt:
+                    raise
+                continue
+            if not resp.get("ok"):
+                raise RuntimeError(f"part {part} resolve failed: "
+                                   f"{resp.get('err')}")
+            return {int(g): {"feat": np.asarray(r["feat"], dtype=np.float32),
+                             "in_deg": int(r["in_deg"]),
+                             "out_deg": int(r["out_deg"]),
+                             "in": [int(x) for x in r["in"]]}
+                    for g, r in resp["rows"].items()}
+
+    def close(self):
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()
+
+
+# ----------------------------------------------------------------------------
+# construction + CLI
+# ----------------------------------------------------------------------------
+
+def build_backend_core(cfg: Config, g: Graph, owner: np.ndarray, params,
+                       state, log=print,
+                       hidden: Optional[np.ndarray] = None,
+                       logits: Optional[np.ndarray] = None,
+                       obs: Optional[obs_mod.Obs] = None) -> BackendCore:
+    """BackendCore for part cfg.serve_part. A full (hidden, logits) table
+    is sliced to the shard; the in-process precompute is deterministic, so
+    every backend slicing the same checkpoint's table agrees bitwise with
+    the single-host server's rows."""
+    cfg = cfg.replace(n_feat=g.n_feat, n_class=g.n_class, n_train=g.n_train)
+    spec = spec_from_config(cfg)
+    graph = PartGraph(g, owner, cfg.serve_part)
+    if hidden is None or logits is None:
+        t0 = time.perf_counter()
+        hidden, logits = full_graph_embeddings(params, state, spec, g,
+                                               cfg.edge_chunk)
+        log(f"[backend {graph.part}] precomputed the full table in "
+            f"{time.perf_counter() - t0:.1f}s; keeping the "
+            f"{graph.n_own}-row shard")
+    hidden = np.asarray(hidden)
+    logits = np.asarray(logits)
+    if hidden.shape[0] == g.n_nodes:        # full table -> shard slice
+        hidden = hidden[graph.own_ids]
+        logits = logits[graph.own_ids]
+    return BackendCore(cfg, spec, graph, params, state,
+                       np.array(hidden, copy=True),
+                       np.array(logits, copy=True), log=log, obs=obs)
+
+
+def _register_with_router(cfg: Config, port: int, log,
+                          deadline_s: float = 120.0) -> None:
+    """Announce (part, replica, addr, port) to the router, retrying while
+    it comes up — backend/router start order is free, like the rank
+    coordinator's."""
+    raddr, rport = router_endpoint(cfg)
+    resp = coord_mod.rpc_line_json(
+        raddr, rport,
+        {"op": "register", "part": cfg.serve_part,
+         "replica": cfg.serve_replica,
+         "addr": cfg.serve_addr or "127.0.0.1", "port": port},
+        time.monotonic() + deadline_s, what="serve router")
+    if not resp.get("ok"):
+        raise ConfigError(f"router at {raddr}:{rport} rejected "
+                          f"registration: {resp.get('err')}")
+    log(f"[backend] registered as {resp.get('id')} with the router at "
+        f"{raddr}:{rport}"
+        + (f" (fleet waiting on parts {resp['missing_parts']})"
+           if resp.get("missing_parts") else ""))
+
+
+def backend_main(argv=None) -> int:
+    """`python -m bnsgcn_tpu.main serve-backend ...`.
+
+    Exit codes: 0 clean shutdown (router-forwarded 'shutdown' op), 75
+    graceful SIGTERM/SIGINT drain (delta-log shard flushed, resumable),
+    2 config error."""
+    cfg = parse_config(argv)
+    if not cfg.graph_name:
+        cfg = cfg.replace(graph_name=cfg.derive_graph_name())
+    log = print
+    # deterministic obs rank from the shard coordinates (rank 0 is the
+    # router): per-backend event logs land as PATH.r<rank> siblings, which
+    # tools/obs_report.py already auto-discovers
+    rank = 1 + cfg.serve_part * max(cfg.part_replicas, 1) + cfg.serve_replica
+    obs = obs_mod.make_obs(cfg, rank=rank, log=log)
+    try:
+        part_dir = artifacts_dir(cfg)
+        owner = load_owner_map(part_dir)
+        n_parts = int(owner.max()) + 1
+        if not 0 <= cfg.serve_part < n_parts:
+            raise ConfigError(f"--serve-part {cfg.serve_part} out of range "
+                              f"[0, {n_parts}) for the artifacts at "
+                              f"{part_dir}")
+        if cfg.serve_replica < 0:
+            raise ConfigError(f"--serve-replica must be >= 0, got "
+                              f"{cfg.serve_replica}")
+        from bnsgcn_tpu.data.datasets import load_data
+        g, _, _ = load_data(cfg)
+        cfg = cfg.replace(n_feat=g.n_feat, n_class=g.n_class,
+                          n_train=g.n_train)
+        params, state, _, _ = serve._load_model(cfg, log)
+        hidden = logits = None
+        if cfg.embeddings:
+            hidden, logits, meta = serve.load_table(cfg.embeddings)
+            log(f"[backend] cold start from embedding table "
+                f"{cfg.embeddings} ({hidden.shape[0]} rows)")
+        core = build_backend_core(cfg, g, owner, params, state, log=log,
+                                  hidden=hidden, logits=logits, obs=obs)
+    except ConfigError as ex:
+        print(f"[config] {ex}", file=sys.stderr)
+        sys.exit(2)
+    except ckpt.CheckpointCorrupt as ex:
+        print(f"[config] embedding artifact unusable: {ex}", file=sys.stderr)
+        sys.exit(2)
+
+    serve_dir = cfg.serve_dir or os.path.join(cfg.ckpt_path, "serve")
+    core.serve_dir = serve_dir
+    try:
+        counts = core.load_serving_state(serve_dir)
+    except ckpt.CheckpointCorrupt as ex:
+        print(f"[config] serving snapshot unusable: {ex} — the delta log "
+              f"is only a tail past a snapshot; refusing to resume from a "
+              f"hole in history", file=sys.stderr)
+        sys.exit(2)
+    if counts["replayed"] or counts["folded"]:
+        log(f"[backend {core.backend_id}] resumed: {counts['folded']} "
+            f"delta(s) from the snapshot + {counts['replayed']} replayed "
+            f"from the tail log")
+
+    signals = resilience.PreemptSignals(
+        action="drain in-flight requests and flush the delta-log shard",
+        boundary="request boundary")
+    signals.install()
+    server = BackendServer(core, cfg.serve_backend_port, cfg.serve_addr,
+                           log=log)
+    resolver = PeerResolver(*router_endpoint(cfg))
+    core.graph.resolver = resolver
+    try:
+        _register_with_router(cfg, server.port, log)
+    except (ConfigError, coord_mod.CoordTimeout) as ex:
+        print(f"[config] {ex}", file=sys.stderr)
+        server.drain(timeout_s=2.0)
+        core.close()
+        sys.exit(2)
+
+    stop_refresh = threading.Event()
+
+    def _refresher():
+        while not stop_refresh.wait(cfg.serve_refresh_s):
+            try:
+                core.refresh_some()
+            except Exception as ex:             # noqa: BLE001 — keep serving
+                log(f"[backend {core.backend_id}] background refresh "
+                    f"failed: {type(ex).__name__}: {ex}")
+
+    if cfg.serve_refresh_s > 0:
+        threading.Thread(target=_refresher, name="bnsgcn-backend-refresh",
+                         daemon=True).start()
+
+    log(f"[backend {core.backend_id}] ready on port {server.port}: "
+        f"{core.graph.n_own}/{core.graph.n_nodes} nodes owned, delta-log "
+        f"shard {os.path.join(serve_dir, core._delta_log_name)}")
+    if obs is not None:
+        obs.emit("serve_header", port=server.port,
+                 n_nodes=core.graph.n_nodes, n_own=core.graph.n_own,
+                 part=core.part, replica=core.replica,
+                 backend=core.backend_id, model=cfg.model, hops=core.hops,
+                 max_batch=cfg.serve_max_batch,
+                 replayed=counts["replayed"], folded=counts["folded"])
+    try:
+        while signals.requested is None:
+            if server.shutdown_requested.wait(0.05):
+                break
+    finally:
+        stop_refresh.set()
+        server.drain()
+        core.close()
+        resolver.close()
+        path = core.flush_delta_log(serve_dir)
+        stats = core.snapshot_stats()
+        log(f"[backend {core.backend_id}] drained: {stats['requests']} "
+            f"request(s) (A {stats['tier_a']} / B {stats['tier_b']}), "
+            f"{stats['deltas']} journaled delta(s) flushed to {path}, "
+            f"{stats['dirty']} node(s) left dirty")
+        if obs is not None:
+            obs.emit("serve_drain", **{k: stats[k] for k in sorted(stats)})
+            obs.close()
+        signals.restore()
+    if signals.requested is not None:
+        log(f"[backend {core.backend_id}] {signals.requested} honored: "
+            f"resumable delta-log shard flushed")
+        sys.exit(resilience.EXIT_PREEMPTED)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(backend_main())

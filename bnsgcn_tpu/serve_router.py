@@ -1,0 +1,788 @@
+"""Partition-sharded serving, router half: one line-JSON listener fronting
+a fleet of per-part backends (serve_backend.py).
+
+The training partition artifacts become the serving shard map: `meta.json` +
+each part's `global_nid` give the `global node id -> owning part` table, and
+the router forwards every op to the backend(s) that own the nodes it
+touches. Reads (`predict`/`predict_many`) go to ONE replica of the owning
+part, round-robined, over pooled persistent connections (coord.
+LineJsonClient — resends once on a torn response, safe because reads are
+idempotent). Writes (`add_edges`/`update_feat`) are serialized under the
+router's delta lock and fan out in three phases with the at-most-once
+discipline (`rpc_line_json(retry_sent=False)` — a delta must never be
+ingested twice):
+
+  1. apply   — the owning parts' replicas append the edge halves / feature
+               row they own (and journal them to their shard delta logs);
+  2. invalidate — EVERY backend drops the touched nodes from its remote-
+               halo cache (a cached boundary row is valid exactly until its
+               owner changes it);
+  3. mark    — the <= L-hop forward closure of the touched nodes is marked
+               dirty by a distributed BFS: each owning part walks its local
+               out-edges and returns the cross-part frontier with the
+               remaining hop budget; the router continues the wave with a
+               global best-budget dedup until it dries up.
+
+The router replies to the writing client only after all three phases, so a
+client's own follow-up read always sees its delta (the same ordering the
+single-host core gets from one lock hold).
+
+Failure semantics: a backend that misses its deadline on a read is evicted
+from the fleet and the next replica is tried; with no live replica left the
+client gets a named error (`RouteError: part P ...`) within the route
+deadline — never a hang. A backend lost mid-write fan-out is evicted and
+reported in the response; the delta is journaled by the replicas that took
+it, and the resolve/halo path keeps serving from the survivors.
+
+This module deliberately imports none of the model/XLA stack: the router
+holds no table and runs no forward — it is pure routing + bookkeeping over
+the coordinator transport (the CLI pulls resilience, and thus jax, only for
+the signal-handling idiom; the routing classes stay import-light for unit
+tests).
+
+CLI:  python -m bnsgcn_tpu.main serve-router --dataset ... \
+          --part-path ... --serve-port 18120 [--parts P] [--part-replicas R]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from bnsgcn_tpu import obs as obs_mod
+from bnsgcn_tpu.config import Config, ConfigError, parse_config
+from bnsgcn_tpu.parallel import coord as coord_mod
+
+
+class RouteError(ValueError):
+    """No live backend could answer for a part — named, deadline-bounded,
+    and converted to an {"ok": False} response by the dispatcher."""
+
+
+def router_endpoint(cfg: Config) -> tuple[str, int]:
+    """(addr, port) a backend registers with / a client connects to, from
+    --serve-router 'host:port' (default 127.0.0.1:{--serve-port})."""
+    if cfg.serve_router:
+        host, _, port = cfg.serve_router.rpartition(":")
+        if not host or not port.isdigit():
+            raise ConfigError(f"--serve-router must be 'host:port', got "
+                              f"{cfg.serve_router!r}")
+        return host, int(port)
+    return "127.0.0.1", cfg.serve_port
+
+
+def artifacts_dir(cfg: Config) -> str:
+    """Where the training partition artifacts live — mirrors
+    run.artifacts_dir without importing the jax-heavy training stack."""
+    name = cfg.graph_name or cfg.derive_graph_name()
+    return os.path.join(cfg.part_path, name)
+
+
+def load_owner_map(part_dir: str) -> np.ndarray:
+    """[n_nodes] int32 `global node id -> owning part`, from the training
+    partition artifacts (meta.json n_inner + each part{p}.npz global_nid).
+    The boundary-node tables the training halo exchange indexes by are the
+    same ids — this map IS the serving shard map, no re-partitioning."""
+    meta_path = os.path.join(part_dir, "meta.json")
+    if not os.path.exists(meta_path):
+        raise ConfigError(
+            f"no partition artifacts at {part_dir} — build them first "
+            f"(python -m bnsgcn_tpu.data.partition_cli ... or any training "
+            f"run over this dataset/partition config)")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    n = int(np.sum(np.asarray(meta["n_inner"], dtype=np.int64)))
+    owner = np.full(n, -1, dtype=np.int32)
+    for p in range(int(meta["n_parts"])):
+        with np.load(os.path.join(part_dir, f"part{p}.npz")) as z:
+            gnid = np.asarray(z["global_nid"], dtype=np.int64)
+        gnid = gnid[gnid >= 0]
+        if gnid.size and (gnid.max() >= n or (owner[gnid] >= 0).any()):
+            raise ConfigError(
+                f"partition artifacts at {part_dir} are inconsistent: part "
+                f"{p} claims nodes outside [0, {n}) or already owned")
+        owner[gnid] = p
+    if (owner < 0).any():
+        raise ConfigError(
+            f"partition artifacts at {part_dir} do not cover the graph "
+            f"({int((owner < 0).sum())}/{n} nodes unowned)")
+    return owner
+
+
+# ----------------------------------------------------------------------------
+# the fleet: registered backends + pooled read connections
+# ----------------------------------------------------------------------------
+
+class Fleet:
+    """Registry of live backends keyed (part, replica): addresses, a small
+    pool of persistent read connections each (a LineJsonClient serializes
+    its in-flight request, so one connection per backend would queue
+    concurrent routed reads behind each other), and per-part round-robin
+    state."""
+
+    POOL = 4        # persistent read connections per backend
+
+    def __init__(self, n_parts: int, replicas: int,
+                 route_timeout_s: float = 15.0):
+        self.n_parts = int(n_parts)
+        self.replicas = int(replicas)
+        self.route_timeout_s = route_timeout_s
+        self._lock = threading.Lock()
+        self._backends: dict = {}   # guarded-by: self._lock
+        self._clients: dict = {}    # guarded-by: self._lock
+        self._rr: dict = {}         # guarded-by: self._lock
+        self._crr: dict = {}        # guarded-by: self._lock
+
+    def register(self, part: int, replica: int, addr: str, port: int) -> str:
+        part, replica = int(part), int(replica)
+        if not 0 <= part < self.n_parts:
+            raise ValueError(f"part {part} out of range [0, {self.n_parts})")
+        if not 0 <= replica < self.replicas:
+            raise ValueError(f"replica {replica} out of range "
+                             f"[0, {self.replicas})")
+        bid = f"p{part}.r{replica}"
+        with self._lock:
+            old = self._clients.pop((part, replica), [])
+            self._backends[(part, replica)] = {
+                "addr": addr, "port": int(port), "id": bid}
+        for c in old:
+            c.close()       # re-registration (backend restart) wins
+        return bid
+
+    def evict(self, part: int, replica: int):
+        with self._lock:
+            self._backends.pop((part, replica), None)
+            old = self._clients.pop((part, replica), [])
+        for c in old:
+            c.close()
+
+    def missing_parts(self) -> list[int]:
+        with self._lock:
+            covered = {p for p, _ in self._backends}
+        return [p for p in range(self.n_parts) if p not in covered]
+
+    def replicas_of(self, part: int) -> list[int]:
+        with self._lock:
+            return sorted(r for p, r in self._backends if p == int(part))
+
+    def endpoint(self, part: int, replica: int) -> Optional[dict]:
+        with self._lock:
+            be = self._backends.get((int(part), int(replica)))
+            return dict(be) if be else None
+
+    def client(self, part: int, replica: int
+               ) -> Optional[coord_mod.LineJsonClient]:
+        """A pooled read connection to one backend (idempotent ops only):
+        grown lazily up to POOL, then round-robined — concurrent routed
+        reads must not queue behind one another's round trip."""
+        key = (int(part), int(replica))
+        with self._lock:
+            be = self._backends.get(key)
+            if be is None:
+                return None
+            pool = self._clients.setdefault(key, [])
+            if len(pool) < self.POOL:
+                c = coord_mod.LineJsonClient(be["addr"], be["port"],
+                                             timeout_s=self.route_timeout_s,
+                                             what=f"backend {be['id']}")
+                pool.append(c)
+                return c
+            i = self._crr.get(key, 0)
+            self._crr[key] = i + 1
+            return pool[i % len(pool)]
+
+    def pick(self, part: int) -> Optional[int]:
+        """Round-robin replica choice for a read on `part`."""
+        part = int(part)
+        with self._lock:
+            live = sorted(r for p, r in self._backends if p == part)
+            if not live:
+                return None
+            i = self._rr.get(part, 0)
+            self._rr[part] = i + 1
+        return live[i % len(live)]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out: dict = {str(p): [] for p in range(self.n_parts)}
+            for (p, r), be in sorted(self._backends.items()):
+                out[str(p)].append({"replica": r, "addr": be["addr"],
+                                    "port": be["port"], "id": be["id"]})
+        return out
+
+    def close(self):
+        with self._lock:
+            clients = [c for pool in self._clients.values() for c in pool]
+            self._clients.clear()
+        for c in clients:
+            c.close()
+
+
+# ----------------------------------------------------------------------------
+# the router core: ownership routing + three-phase delta fan-out
+# ----------------------------------------------------------------------------
+
+class RouterCore:
+    """Protocol-level router over a Fleet (the TCP layer below is a thin
+    dispatcher; tests drive this directly). Thread-safe: counters under
+    self._lock, delta fan-out serialized under self._delta_lock."""
+
+    def __init__(self, owner: np.ndarray, n_parts: int, replicas: int = 1,
+                 hops: int = 2, log=print,
+                 obs: Optional[obs_mod.Obs] = None,
+                 route_timeout_s: float = 15.0,
+                 delta_timeout_s: float = 60.0):
+        self.owner = np.asarray(owner, dtype=np.int32)
+        self.n_nodes = int(self.owner.shape[0])
+        self.hops = int(hops)
+        self.log = log
+        self.obs = obs
+        self.route_timeout_s = route_timeout_s
+        self.delta_timeout_s = delta_timeout_s
+        self.fleet = Fleet(n_parts, replicas, route_timeout_s=route_timeout_s)
+        self.registry = obs.registry if obs is not None else obs_mod.Registry()
+        # router-side route-latency histograms, same key names the backends
+        # use so `stats` answers serve_bench's existing server-vs-client
+        # cross-check unchanged
+        self._lat = {t: self.registry.histogram(f"serve/latency_ms/{t}")
+                     for t in ("A", "B")}
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self.stats = {"requests": 0, "tier_a": 0, "tier_b": 0, "deltas": 0,
+                      "fanout_rpcs": 0, "evictions": 0}
+        self._delta_lock = threading.Lock()
+
+    # -- readiness --
+
+    def ready(self) -> list[int]:
+        """[] when every part has at least one live backend; else the
+        missing part ids."""
+        return self.fleet.missing_parts()
+
+    def _require_ready(self):
+        missing = self.ready()
+        if missing:
+            raise RouteError(f"fleet not ready: no backend registered for "
+                             f"part(s) {missing}")
+
+    def _owner_of(self, node: int) -> int:
+        node = int(node)
+        if not 0 <= node < self.n_nodes:
+            raise ValueError(f"node {node} out of range [0, {self.n_nodes})")
+        return int(self.owner[node])
+
+    # -- reads: round-robined, evict-on-timeout, pooled connections --
+
+    def _forward_read(self, part: int, req: dict) -> tuple[dict, int]:
+        """(response, replica) from the first live replica of `part`; a
+        replica missing its deadline is evicted and the next one tried —
+        no live replica left raises a named RouteError, never a hang."""
+        tried: list[str] = []
+        for _ in range(max(self.fleet.replicas, 1)):
+            replica = self.fleet.pick(part)
+            if replica is None:
+                break
+            client = self.fleet.client(part, replica)
+            if client is None:
+                continue
+            try:
+                resp = client.request(req)
+            except coord_mod.CoordTimeout as ex:
+                tried.append(f"r{replica} ({ex})")
+                self.fleet.evict(part, replica)
+                with self._lock:
+                    self.stats["evictions"] += 1
+                self.log(f"[router] evicted backend p{part}.r{replica}: {ex}")
+                continue
+            return resp, replica
+        raise RouteError(
+            f"part {part}: no live backend within {self.route_timeout_s}s "
+            f"deadline (tried: {', '.join(tried) or 'none registered'})")
+
+    def predict(self, node: int, tier: Optional[str] = None) -> dict:
+        self._require_ready()
+        t0 = time.perf_counter()
+        part = self._owner_of(node)
+        req = {"op": "predict", "node": int(node)}
+        if tier is not None:
+            req["tier"] = tier
+        resp, replica = self._forward_read(part, req)
+        with self._lock:
+            self.stats["requests"] += 1
+            self.stats["fanout_rpcs"] += 1
+            if resp.get("tier") == "B":
+                self.stats["tier_b"] += 1
+            elif resp.get("tier") == "A":
+                self.stats["tier_a"] += 1
+        # client-side shard tags: serve_bench splits its percentiles by
+        # these without a second round trip
+        resp["part"] = part
+        resp["backend"] = f"p{part}.r{replica}"
+        if resp.get("tier") in ("A", "B"):
+            self._lat[resp["tier"]].observe((time.perf_counter() - t0) * 1e3)
+        return resp
+
+    def predict_many(self, nodes, tier: Optional[str] = None) -> list[dict]:
+        """Split by owning part, forward each shard's slice concurrently,
+        merge back in request order (each result carries its shard tags)."""
+        self._require_ready()
+        nodes = [int(n) for n in nodes]
+        by_part: dict[int, list[int]] = {}
+        for n in nodes:
+            by_part.setdefault(self._owner_of(n), []).append(n)
+        results: dict[int, dict] = {}
+        errors: list[str] = []
+        res_lock = threading.Lock()
+
+        def _one(part: int, shard: list[int]):
+            req = {"op": "predict_many", "nodes": shard}
+            if tier is not None:
+                req["tier"] = tier
+            try:
+                resp, replica = self._forward_read(part, req)
+            except (RouteError, ValueError) as ex:
+                with res_lock:
+                    errors.append(str(ex))
+                return
+            if not resp.get("ok"):
+                with res_lock:
+                    errors.append(f"part {part}: {resp.get('err')}")
+                return
+            with res_lock:
+                for r in resp["results"]:
+                    r["part"] = part
+                    r["backend"] = f"p{part}.r{replica}"
+                    results[int(r["node"])] = r
+
+        threads = [threading.Thread(target=_one, args=(p, shard))
+                   for p, shard in sorted(by_part.items())]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RouteError("; ".join(errors))
+        with self._lock:
+            self.stats["requests"] += len(nodes)
+            self.stats["fanout_rpcs"] += len(by_part)
+            for n in nodes:
+                tr = results[n].get("tier")
+                if tr == "B":
+                    self.stats["tier_b"] += 1
+                elif tr == "A":
+                    self.stats["tier_a"] += 1
+        return [results[n] for n in nodes]
+
+    # -- writes: three-phase fan-out under the delta lock --
+
+    def _send_write(self, part: int, replica: int, req: dict,
+                    timeout_s: Optional[float] = None) -> Optional[dict]:
+        """At-most-once write to ONE backend (rpc_line_json fresh
+        connection, retry_sent=False — a delta must never apply twice).
+        Returns None (and evicts) on failure."""
+        be = self.fleet.endpoint(part, replica)
+        if be is None:
+            return None
+        try:
+            resp = coord_mod.rpc_line_json(
+                be["addr"], be["port"], req,
+                time.monotonic() + (timeout_s or self.delta_timeout_s),
+                what=f"backend {be['id']}", retry_sent=False)
+        except coord_mod.CoordTimeout as ex:
+            self.fleet.evict(part, replica)
+            with self._lock:
+                self.stats["evictions"] += 1
+            self.log(f"[router] evicted backend p{part}.r{replica} "
+                     f"mid-write: {ex}")
+            return None
+        with self._lock:
+            self.stats["fanout_rpcs"] += 1
+        return resp
+
+    def _fan_part_write(self, part: int, req: dict) -> list[dict]:
+        """The same write to EVERY live replica of `part` (replica state
+        must stay identical); returns the ok responses."""
+        out = []
+        for replica in self.fleet.replicas_of(part):
+            resp = self._send_write(part, replica, req)
+            if resp is not None and resp.get("ok"):
+                out.append(resp)
+        return out
+
+    def _invalidate_all(self, nodes: list[int]):
+        """Phase 2: every backend drops the touched nodes from its halo
+        cache — a cached boundary row is valid exactly until its owner
+        changes it."""
+        req = {"op": "invalidate", "nodes": [int(v) for v in nodes]}
+        for part in range(self.fleet.n_parts):
+            self._fan_part_write(part, req)
+
+    def _mark_bfs(self, seeds: dict[int, int]) -> int:
+        """Phase 3: distributed dirty-mark BFS. Each wave sends every
+        pending (node, hops_left) to the owning part (ALL replicas — their
+        dirty sets must agree; the frontier is taken from the first ok
+        response since replica graphs are identical); the router dedups
+        globally on best remaining budget, so no node is ever re-walked
+        with a smaller budget than it already got."""
+        best: dict[int, int] = {}
+        work = {int(v): int(h) for v, h in seeds.items()}
+        best.update(work)
+        marked = 0
+        while work:
+            by_part: dict[int, list] = {}
+            for v, h in work.items():
+                by_part.setdefault(self._owner_of(v), []).append([v, h])
+            work = {}
+            for part, batch in sorted(by_part.items()):
+                resps = self._fan_part_write(
+                    part, {"op": "mark", "nodes": sorted(batch)})
+                if not resps:
+                    raise RouteError(
+                        f"part {part}: no live backend took the dirty-mark "
+                        f"fan-out — delta partially applied, retry after "
+                        f"the part re-registers")
+                marked += int(resps[0].get("marked", 0))
+                for v, h in resps[0].get("frontier", []):
+                    v, h = int(v), int(h)
+                    if best.get(v, -1) >= h:
+                        continue
+                    best[v] = h
+                    work[v] = h
+        return marked
+
+    def _dirty_total(self) -> int:
+        total = 0
+        for part in range(self.fleet.n_parts):
+            try:
+                resp, _ = self._forward_read(part, {"op": "dirty"})
+            except RouteError:
+                continue
+            total += int(resp.get("count", 0))
+        return total
+
+    def add_edges(self, edges: list) -> dict:
+        self._require_ready()
+        pairs = [(int(u), int(v)) for u, v in edges]
+        for u, v in pairs:
+            self._owner_of(u), self._owner_of(v)      # range check up front
+        with self._delta_lock:
+            # phase 1: the owning parts append the halves they own
+            by_part: dict[int, list] = {}
+            for u, v in pairs:
+                by_part.setdefault(self._owner_of(u), []).append([u, v])
+                pv = self._owner_of(v)
+                if pv != self._owner_of(u):
+                    by_part.setdefault(pv, []).append([u, v])
+            for part, batch in sorted(by_part.items()):
+                if not self._fan_part_write(
+                        part, {"op": "apply_delta", "edges": batch}):
+                    raise RouteError(
+                        f"part {part}: no live backend took the delta — "
+                        f"nothing applied there; retry after it re-registers")
+            touched = sorted({n for uv in pairs for n in uv})
+            self._invalidate_all(touched)
+            marked = self._mark_bfs({n: self.hops for n in touched})
+            with self._lock:
+                self.stats["deltas"] += 1
+        out = {"ok": True, "dirty_new": marked,
+               "dirty_total": self._dirty_total()}
+        if self.obs is not None:
+            self.obs.emit("delta", op="add_edges", edges=len(pairs),
+                          dirty_new=out["dirty_new"],
+                          dirty_total=out["dirty_total"], routed=True)
+        return out
+
+    def update_feat(self, node: int, vec) -> dict:
+        self._require_ready()
+        node = int(node)
+        part = self._owner_of(node)
+        with self._delta_lock:
+            if not self._fan_part_write(
+                    part, {"op": "apply_feat", "node": node,
+                           "feat": list(vec)}):
+                raise RouteError(
+                    f"part {part}: no live backend took the feature "
+                    f"update — nothing applied; retry after it re-registers")
+            self._invalidate_all([node])
+            marked = self._mark_bfs({node: self.hops})
+            with self._lock:
+                self.stats["deltas"] += 1
+        out = {"ok": True, "dirty_new": marked,
+               "dirty_total": self._dirty_total()}
+        if self.obs is not None:
+            self.obs.emit("delta", op="update_feat", node=node,
+                          dirty_new=out["dirty_new"],
+                          dirty_total=out["dirty_total"], routed=True)
+        return out
+
+    # -- aggregation ops --
+
+    def flush(self) -> int:
+        """Drain every backend's dirty set (long deadline: a flush is a
+        full re-score of the dirty frontier). Non-idempotent (expensive to
+        double-start), so at-most-once per backend."""
+        self._require_ready()
+        total = 0
+        for part in range(self.fleet.n_parts):
+            for resp in self._fan_part_write(
+                    part, {"op": "flush"}):
+                total += int(resp.get("refreshed", 0))
+        return total
+
+    def snapshot_stats(self) -> dict:
+        out: dict = {"ok": True, "n_nodes": self.n_nodes,
+                     "parts": self.fleet.n_parts,
+                     "router": True, "missing_parts": self.ready()}
+        with self._lock:
+            out.update(self.stats)
+        out["dirty"] = self._dirty_total()
+        backends = []
+        for part in range(self.fleet.n_parts):
+            for replica in self.fleet.replicas_of(part):
+                client = self.fleet.client(part, replica)
+                if client is None:
+                    continue
+                try:
+                    resp = client.request({"op": "stats"})
+                except coord_mod.CoordTimeout:
+                    continue
+                if resp.get("ok"):
+                    resp["backend"] = f"p{part}.r{replica}"
+                    backends.append(resp)
+        out["backends"] = backends
+        # router-side route-latency percentiles under the SAME keys the
+        # single-host server reports, so serve_bench's server-vs-client
+        # p50 cross-check works against the router unchanged
+        for t in ("A", "B"):
+            snap = self._lat[t].snapshot()
+            out[f"tier_{t.lower()}_p50_ms"] = snap["p50"]
+            out[f"tier_{t.lower()}_p99_ms"] = snap["p99"]
+        return out
+
+    def metrics(self) -> dict:
+        """Router registry + nested per-backend registry snapshots."""
+        per_backend: dict = {}
+        for part in range(self.fleet.n_parts):
+            for replica in self.fleet.replicas_of(part):
+                client = self.fleet.client(part, replica)
+                if client is None:
+                    continue
+                try:
+                    resp = client.request({"op": "metrics"})
+                except coord_mod.CoordTimeout:
+                    continue
+                if resp.get("ok"):
+                    per_backend[f"p{part}.r{replica}"] = resp["metrics"]
+        return {"ok": True, "metrics": self.registry.snapshot(),
+                "backends": per_backend}
+
+    def shutdown_fleet(self, log=None) -> int:
+        """Forward shutdown to every backend (each drains, flushes its
+        delta-log shard, and exits 0). Returns how many acknowledged."""
+        n = 0
+        for part in range(self.fleet.n_parts):
+            for replica in self.fleet.replicas_of(part):
+                resp = self._send_write(part, replica, {"op": "shutdown"},
+                                        timeout_s=10.0)
+                if resp is not None and resp.get("ok"):
+                    n += 1
+        return n
+
+    def close(self):
+        self.fleet.close()
+
+
+# ----------------------------------------------------------------------------
+# TCP front end
+# ----------------------------------------------------------------------------
+
+class RouterServer:
+    """Line-JSON dispatcher over a RouterCore — same framing, drain
+    discipline and in-flight accounting as serve.ServeServer."""
+
+    # ops that stay answerable while draining, or before the fleet is
+    # complete (registration must be possible before readiness, by
+    # definition)
+    ALWAYS = ("ping", "stats", "metrics", "fleet", "register")
+
+    def __init__(self, core: RouterCore, port: int, addr: str = "",
+                 log=print):
+        self.core = core
+        self.log = log
+        self._inflight = 0      # guarded-by: self._lock
+        self._draining = False  # guarded-by: self._lock
+        self._lock = threading.Lock()
+        self.shutdown_requested = threading.Event()
+        self.server = coord_mod.LineJsonServer(port, self._handle,
+                                               addr=addr).start()
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        with self._lock:
+            if self._draining and op not in self.ALWAYS:
+                return {"ok": False, "err": "draining"}
+            self._inflight += 1
+        try:
+            return self._dispatch(op, req)
+        except (KeyError, ValueError, TypeError) as ex:
+            return {"ok": False, "err": f"{type(ex).__name__}: {ex}"}
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _dispatch(self, op: Optional[str], req: dict) -> dict:
+        core = self.core
+        if op == "ping":
+            return {"ok": True, "router": True}
+        if op == "register":
+            bid = core.fleet.register(req["part"], req.get("replica", 0),
+                                      req.get("addr") or "127.0.0.1",
+                                      req["port"])
+            missing = core.ready()
+            self.log(f"[router] registered backend {bid} at "
+                     f"{req.get('addr') or '127.0.0.1'}:{req['port']}"
+                     + (f" (waiting on parts {missing})" if missing
+                        else " (fleet complete)"))
+            return {"ok": True, "id": bid, "missing_parts": missing}
+        if op == "fleet":
+            return {"ok": True, "parts": core.fleet.snapshot(),
+                    "missing_parts": core.ready()}
+        if op == "predict":
+            return core.predict(req["node"], tier=req.get("tier"))
+        if op == "predict_many":
+            return {"ok": True, "results": core.predict_many(
+                req["nodes"], tier=req.get("tier"))}
+        if op == "add_edges":
+            return core.add_edges(req["edges"])
+        if op == "update_feat":
+            return core.update_feat(req["node"], req["feat"])
+        if op == "flush":
+            return {"ok": True, "refreshed": core.flush()}
+        if op == "dirty":
+            core._require_ready()
+            return {"ok": True, "count": core._dirty_total()}
+        if op == "stats":
+            return core.snapshot_stats()
+        if op == "metrics":
+            return core.metrics()
+        if op == "shutdown":
+            self.shutdown_requested.set()
+            return {"ok": True}
+        return {"ok": False, "err": f"unknown op {op!r}"}
+
+    def drain(self, timeout_s: float = 30.0, stop: bool = True):
+        """Reject new client ops, wait out in-flight handlers; `stop=False`
+        keeps the listener up (the shutdown sequence still answers
+        ping/stats while the backends drain behind it)."""
+        with self._lock:
+            self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.01)
+        if stop:
+            self.server.stop()
+
+    def stop(self):
+        self.server.stop()
+
+
+# ----------------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------------
+
+def router_main(argv=None) -> int:
+    """`python -m bnsgcn_tpu.main serve-router ...`.
+
+    Exit codes: 0 clean fleet shutdown (client 'shutdown' op — forwarded to
+    every backend), 75 graceful SIGTERM/SIGINT drain (backends keep
+    running; the orchestrator owns their lifecycle), 2 config error."""
+    from bnsgcn_tpu import resilience
+    cfg = parse_config(argv)
+    if not cfg.graph_name:
+        cfg = cfg.replace(graph_name=cfg.derive_graph_name())
+    log = print
+    obs = obs_mod.make_obs(cfg, rank=0, log=log)
+    try:
+        part_dir = artifacts_dir(cfg)
+        owner = load_owner_map(part_dir)
+        n_parts_art = int(owner.max()) + 1
+        n_parts = cfg.parts if cfg.parts > 0 else n_parts_art
+        if n_parts != n_parts_art:
+            raise ConfigError(
+                f"--parts {n_parts} != the {n_parts_art} parts in the "
+                f"artifacts at {part_dir} — the shard map comes from the "
+                f"training partition; re-partition or drop --parts")
+        if cfg.part_replicas < 1:
+            raise ConfigError(f"--part-replicas must be >= 1, got "
+                              f"{cfg.part_replicas}")
+        # L-hop budget for the distributed dirty-mark BFS: the model's
+        # graph-layer count (ModelSpec.n_graph_layers = n_layers - n_linear,
+        # computed flag-side so the router stays jax-free), same hop budget
+        # as the single-host forward_closure
+        hops = cfg.n_layers - cfg.n_linear
+        if hops < 1:
+            raise ConfigError(f"--n-layers {cfg.n_layers} with --n-linear "
+                              f"{cfg.n_linear} leaves no graph layer")
+    except ConfigError as ex:
+        print(f"[config] {ex}", file=sys.stderr)
+        sys.exit(2)
+
+    core = RouterCore(owner, n_parts, replicas=cfg.part_replicas, hops=hops,
+                      log=log, obs=obs)
+    signals = resilience.PreemptSignals(
+        action="drain in-flight routed requests",
+        boundary="request boundary")
+    signals.install()
+    server = RouterServer(core, cfg.serve_port, cfg.serve_addr, log=log)
+    log(f"[router] ready on port {server.port}: {n_parts} part(s) x "
+        f"{cfg.part_replicas} replica(s), {core.n_nodes} nodes, "
+        f"{hops}-hop dirty fan-out; waiting for backends to register")
+    try:
+        while signals.requested is None:
+            if server.shutdown_requested.wait(0.05):
+                break
+    finally:
+        clean = server.shutdown_requested.is_set()
+        # drain ordering: stop taking client ops -> wait in-flight -> (on a
+        # clean shutdown) forward shutdown so every backend flushes its
+        # delta-log shard -> stop the listener
+        server.drain(stop=False)
+        acked = core.shutdown_fleet() if clean else 0
+        server.stop()
+        with core._lock:
+            stats = dict(core.stats)
+        log(f"[router] drained: {stats['requests']} request(s) routed "
+            f"(A {stats['tier_a']} / B {stats['tier_b']}), "
+            f"{stats['deltas']} delta(s) fanned out over "
+            f"{stats['fanout_rpcs']} backend RPCs, "
+            f"{stats['evictions']} eviction(s)"
+            + (f", {acked} backend(s) shut down" if clean else ""))
+        if obs is not None:
+            obs.emit("serve_fleet", parts=n_parts,
+                     replicas=cfg.part_replicas, shutdown_acked=acked,
+                     **{k: stats[k] for k in sorted(stats)})
+            obs.close()
+        core.close()
+        signals.restore()
+    if signals.requested is not None:
+        log(f"[router] {signals.requested} honored: backends keep serving; "
+            f"relaunch the router to resume fronting them")
+        sys.exit(resilience.EXIT_PREEMPTED)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(router_main())

@@ -171,6 +171,48 @@ def test_obs_report_renders_nan_sanitized_records(tmp_path):
     obs_report.compare(s, s, path, path, write=lines.append)
 
 
+def test_obs_report_serving_fleet_section(tmp_path):
+    """Sharded-serving logs (router rank 0 + backend `.rN` siblings) render
+    a per-backend fleet table plus the router fan-out line, while the
+    legacy single-host `serve` slot keeps its meaning: it only ever holds a
+    drain record WITHOUT a backend tag."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    path = str(tmp_path / "fleet.jsonl")
+    ev = obs_mod.EventLog(path)       # rank 0 = the router
+    ev.emit("serve_fleet", parts=2, replicas=1, shutdown_acked=2,
+            requests=40, tier_a=36, tier_b=4, deltas=3, fanout_rpcs=9,
+            evictions=0)
+    ev.close()
+    for part in (0, 1):               # backend shards on sibling logs
+        bev = obs_mod.EventLog(obs_mod.rank_log_path(path, 1 + part))
+        bev.emit("serve_drain", requests=20, tier_a=18, tier_b=2,
+                 deltas=3, refreshed_nodes=5, part=part, replica=0,
+                 backend=f"p{part}.r0", n_own=150, queue_depth=0,
+                 tier_a_p50_ms=0.4, tier_a_p99_ms=1.1, tier_b_p50_ms=8.0,
+                 tier_b_p99_ms=20.0, refresh_lag_p50_s=0.01,
+                 refresh_lag_p99_s=0.05, halo_cached=7, halo_fetches=2,
+                 halo_hits=11)
+        bev.close()
+    s = obs_report.summarize(obs_report.load_run([path]))
+    assert s["serve"] is None                 # no untagged drain in this log
+    assert len(s["serve_drains"]) == 2
+    assert s["serve_fleet"]["fanout_rpcs"] == 9
+    lines = []
+    obs_report.render(s, write=lines.append)
+    text = "\n".join(lines)
+    assert "serving fleet:" in text
+    assert "p0.r0" in text and "p1.r0" in text
+    assert "9 fan-out RPCs" in text
+    # a single-host drain (no backend tag) still lands in the legacy slot
+    s2 = obs_report.summarize([{"kind": "serve_drain", "requests": 1,
+                                "ts": 0.0}])
+    assert s2["serve"] is not None and s2["serve_drains"]
+
+
 def test_write_postmortem_failure_returns_empty():
     """An unwritable post-mortem dir returns "" (no breadcrumb to a ghost
     file) instead of a path that was never written."""

@@ -56,3 +56,43 @@ def test_serve_bench_emits_full_metric_vocabulary():
     # last line wins for the driver: it must be a valid vocabulary metric
     last = lines[-1]
     assert last["metric"] == "serve_qps" and last["tier"] == "A"
+
+
+@pytest.mark.slow
+def test_bench_serve_dispatch_tags_backend_counts():
+    """bench.py --serve both: the driver-facing entry point runs BOTH
+    serving variants (single-host and the 2-part router-fronted fleet)
+    and every metric line carries the backend-count tags that keep a
+    serve1 number from ever being compared against a serve2p one."""
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--serve", "both", "--serve-requests", "24",
+           "--serve-concurrency", "2"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                       cwd=REPO, env=_env())
+    tail = "\n".join((r.stdout + "\n" + r.stderr).splitlines()[-25:])
+    assert r.returncode == 0, f"bench --serve failed preflight:\n{tail}"
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON metric lines:\n{tail}"
+    by_variant: dict = {}
+    for ln in lines:
+        assert ln["metric"] in SERVE_METRICS, f"off-vocabulary: {ln}"
+        assert ln["variant"] in ("serve1", "serve2p"), ln
+        by_variant.setdefault(ln["variant"], []).append(ln)
+    assert set(by_variant) == {"serve1", "serve2p"}, f"missing variant:\n{tail}"
+    assert all(ln["backends"] == 1 for ln in by_variant["serve1"])
+    for ln in by_variant["serve2p"]:
+        assert ln["backends"] == 2
+        # the routed fleet measured its own router tax vs a direct backend
+        assert ln["router_overhead_x"] > 0
+    # both variants emit the full vocabulary for both tiers
+    for variant, vlines in by_variant.items():
+        seen = {(ln["metric"], ln.get("tier")) for ln in vlines}
+        for metric in SERVE_METRICS:
+            for tier in ("A", "B"):
+                assert (metric, tier) in seen, \
+                    f"missing {metric}/{tier} in {variant}:\n{tail}"
+    # last line wins: the serve2p tier-A qps closes the run
+    last = lines[-1]
+    assert last["metric"] == "serve_qps" and last["tier"] == "A"
+    assert last["variant"] == "serve2p" and last["backends"] == 2

@@ -1,0 +1,446 @@
+"""Partition-sharded serving (serve_router.py + serve_backend.py), the
+in-process half: ownership-map loading, fleet routing units, the named
+backend-down error (deadline-bounded, never a hang), drain ordering, the
+2-backend bitwise contract vs the single-host server (tier A and tier B,
+including cross-part closures and post-delta refresh), replica read
+consistency, and delta-log compaction relaunch. The subprocess twin lives
+in tests/test_serve_dist_e2e.py."""
+
+import json
+import os
+import socket
+import threading
+import time
+from functools import lru_cache
+
+import jax
+import numpy as np
+import pytest
+
+from bnsgcn_tpu import serve
+from bnsgcn_tpu import serve_backend as sb
+from bnsgcn_tpu import serve_router as sr
+from bnsgcn_tpu.config import Config, ConfigError
+from bnsgcn_tpu.data.graph import sbm_graph
+from bnsgcn_tpu.models.gnn import init_params, spec_from_config
+from bnsgcn_tpu.parallel import coord
+
+
+# ----------------------------------------------------------------------------
+# shared fixture: one graph + model + full table, partitioned two ways
+# ----------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _setup():
+    g = sbm_graph(n_nodes=300, n_class=4, n_feat=8, seed=0)
+    cfg = Config(dataset="sbm", model="gcn", n_layers=2, n_hidden=8,
+                 n_feat=g.n_feat, n_class=g.n_class, n_train=g.n_train,
+                 serve_max_batch=16)
+    spec = spec_from_config(cfg)
+    params, state = init_params(jax.random.key(1), spec)
+    from bnsgcn_tpu.evaluate import full_graph_embeddings
+    hidden, logits = full_graph_embeddings(params, state, spec, g)
+    rng = np.random.default_rng(7)
+    owner = rng.integers(0, 2, size=g.n_nodes).astype(np.int32)
+    owner[:2] = [0, 1]                      # both parts non-empty
+    return g, cfg, params, state, np.asarray(hidden), np.asarray(logits), owner
+
+
+class _Fleet2:
+    """Two (or 2xR) real backends behind a real router server, all
+    in-process: TCP routing/fan-out exactly as production, tables sliced
+    from ONE precomputed full table (so bitwise comparisons are against
+    the same rows the single-host core serves)."""
+
+    def __init__(self, replicas=1, serve_dir="", compact=0):
+        g, cfg, params, state, hidden, logits, owner = _setup()
+        self.g, self.owner = g, owner
+        self.cfg = cfg.replace(part_replicas=replicas,
+                               serve_compact_deltas=compact)
+        self.rcore = sr.RouterCore(owner, 2, replicas=replicas, hops=2,
+                                   log=lambda *a: None)
+        self.router = sr.RouterServer(self.rcore, 0, log=lambda *a: None)
+        self.cores, self.servers, self.resolvers = [], [], []
+        for part in (0, 1):
+            for rep in range(replicas):
+                c = sb.build_backend_core(
+                    self.cfg.replace(serve_part=part, serve_replica=rep),
+                    g, owner, params, state, log=lambda *a: None,
+                    hidden=hidden, logits=logits)
+                if serve_dir:
+                    c.serve_dir = serve_dir
+                    c.load_serving_state(serve_dir)
+                s = sb.BackendServer(c, 0, log=lambda *a: None)
+                res = sb.PeerResolver("127.0.0.1", self.router.port)
+                c.graph.resolver = res
+                self.rcore.fleet.register(part, rep, "127.0.0.1", s.port)
+                self.cores.append(c)
+                self.servers.append(s)
+                self.resolvers.append(res)
+
+    def close(self):
+        for s in self.servers:
+            s.drain(timeout_s=2.0)
+        for c in self.cores:
+            c.close()
+        for r in self.resolvers:
+            r.close()
+        self.router.drain(timeout_s=2.0)
+        self.rcore.close()
+
+
+# ----------------------------------------------------------------------------
+# ownership map from the training partition artifacts
+# ----------------------------------------------------------------------------
+
+def _write_parts(path, n_inner, gnids):
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"n_parts": len(gnids), "n_inner": n_inner}, f)
+    for p, ids in enumerate(gnids):
+        np.savez(os.path.join(path, f"part{p}.npz"),
+                 global_nid=np.asarray(ids, dtype=np.int64))
+
+
+def test_load_owner_map_roundtrip(tmp_path):
+    d = str(tmp_path / "parts")
+    # padded global_nid rows (-1 tail) exactly as the artifacts write them
+    _write_parts(d, [3, 2], [[4, 0, 2, -1], [1, 3, -1, -1]])
+    owner = sr.load_owner_map(d)
+    assert owner.tolist() == [0, 1, 0, 1, 0]
+
+
+def test_load_owner_map_named_errors(tmp_path):
+    with pytest.raises(ConfigError, match="no partition artifacts"):
+        sr.load_owner_map(str(tmp_path / "nope"))
+    d = str(tmp_path / "gap")
+    _write_parts(d, [3, 2], [[4, 0, -1], [1, 3, -1]])     # node 2 unowned
+    with pytest.raises(ConfigError, match="do not cover"):
+        sr.load_owner_map(d)
+    d = str(tmp_path / "dup")
+    _write_parts(d, [3, 2], [[4, 0, 2], [1, 3, 2]])       # node 2 owned twice
+    with pytest.raises(ConfigError, match="inconsistent"):
+        sr.load_owner_map(d)
+
+
+def test_router_endpoint_parsing():
+    assert sr.router_endpoint(Config(serve_port=1234)) == ("127.0.0.1", 1234)
+    assert sr.router_endpoint(Config(serve_router="h0:9")) == ("h0", 9)
+    with pytest.raises(ConfigError):
+        sr.router_endpoint(Config(serve_router="garbage"))
+
+
+# ----------------------------------------------------------------------------
+# fleet units: registration, round-robin, eviction
+# ----------------------------------------------------------------------------
+
+def test_fleet_registration_and_round_robin():
+    f = sr.Fleet(2, 2)
+    assert f.missing_parts() == [0, 1]
+    assert f.pick(0) is None
+    assert f.register(0, 0, "a", 1) == "p0.r0"
+    assert f.register(0, 1, "a", 2) == "p0.r1"
+    assert f.missing_parts() == [1]
+    f.register(1, 0, "a", 3)
+    assert f.missing_parts() == []
+    # round-robin alternates the live replicas of part 0
+    assert [f.pick(0) for _ in range(4)] == [0, 1, 0, 1]
+    f.evict(0, 0)
+    assert [f.pick(0) for _ in range(2)] == [1, 1]
+    assert f.replicas_of(0) == [1]
+    with pytest.raises(ValueError):
+        f.register(2, 0, "a", 4)            # part out of range
+    with pytest.raises(ValueError):
+        f.register(0, 2, "a", 4)            # replica out of range
+    f.close()
+
+
+def test_part_graph_preserves_single_host_edge_order():
+    """The owned-dst CSR restriction is an order-preserving filter: every
+    owned node's in/out neighbor lists are exactly the single-host
+    DynamicGraph's — the root of the tier-B bitwise contract."""
+    g, _, _, _, _, _, owner = _setup()
+    dg = serve.DynamicGraph(g)
+    pg = sb.PartGraph(g, owner, 0)
+    own = np.flatnonzero(owner == 0)[:40]
+    for v in own.tolist():
+        assert pg.in_nbrs(v) == dg.in_nbrs(v)
+        assert pg.out_nbrs(v) == dg.out_nbrs(v)
+        assert pg.in_deg_of([v])[0] == dg.in_deg[v]
+        assert pg.out_deg_of([v])[0] == dg.out_deg[v]
+        assert np.array_equal(pg.feat_rows([v])[0], dg.feat[v])
+    remote = int(np.flatnonzero(owner == 1)[0])
+    with pytest.raises(serve.HaloCacheMiss):
+        pg.in_nbrs(remote)                  # cache-only without a resolver
+    with pytest.raises(ValueError, match="mis-routed"):
+        pg.local_of(remote)
+
+
+def test_backend_rejects_unrouted_writes():
+    g, cfg, params, state, hidden, logits, owner = _setup()
+    core = sb.build_backend_core(cfg.replace(serve_part=0), g, owner,
+                                 params, state, log=lambda *a: None,
+                                 hidden=hidden, logits=logits)
+    try:
+        with pytest.raises(ValueError, match="must route"):
+            core.add_edges([[0, 1]])
+        with pytest.raises(ValueError, match="must route"):
+            core.update_feat(0, [0.0] * g.n_feat)
+    finally:
+        core.close()
+
+
+# ----------------------------------------------------------------------------
+# failure semantics: named error within the deadline, never a hang
+# ----------------------------------------------------------------------------
+
+def test_backend_down_is_named_error_not_hang():
+    _, _, _, _, _, _, owner = _setup()
+    core = sr.RouterCore(owner, 2, hops=2, log=lambda *a: None,
+                         route_timeout_s=1.0)
+    with socket.socket() as s:              # a port nothing listens on
+        s.bind(("127.0.0.1", 0))
+        dead = s.getsockname()[1]
+    core.fleet.register(0, 0, "127.0.0.1", dead)
+    core.fleet.register(1, 0, "127.0.0.1", dead)
+    t0 = time.monotonic()
+    with pytest.raises(sr.RouteError, match=r"part \d: no live backend"):
+        core.predict(0)
+    assert time.monotonic() - t0 < 10.0     # bounded by the route deadline
+    with core._lock:
+        assert core.stats["evictions"] >= 1
+    # the dead backend was evicted -> the fleet is no longer ready, which
+    # is itself a named error
+    with pytest.raises(sr.RouteError, match="fleet not ready"):
+        core.predict(0)
+    core.close()
+
+
+def test_router_not_ready_and_drain_ordering():
+    _, _, _, _, _, _, owner = _setup()
+    core = sr.RouterCore(owner, 2, hops=2, log=lambda *a: None)
+    server = sr.RouterServer(core, 0, log=lambda *a: None)
+    try:
+        port = server.port
+        r = serve.request(port, {"op": "ping"})
+        assert r["ok"] and r["router"]
+        # reads before the fleet is complete: named error, not a hang
+        r = serve.request(port, {"op": "predict", "node": 0})
+        assert not r["ok"] and "fleet not ready" in r["err"]
+        r = serve.request(port, {"op": "register", "part": 0, "replica": 0,
+                                 "addr": "127.0.0.1", "port": 1})
+        assert r["ok"] and r["id"] == "p0.r0" and r["missing_parts"] == [1]
+        r = serve.request(port, {"op": "fleet"})
+        assert r["ok"] and r["parts"]["0"][0]["id"] == "p0.r0"
+        # drain ordering: client ops are rejected first, while ping/stats/
+        # register stay answerable (a late backend must still be able to
+        # re-register mid-shutdown)
+        server.drain(stop=False)
+        assert not serve.request(port, {"op": "predict", "node": 0})["ok"]
+        assert serve.request(port, {"op": "ping"})["ok"]
+        assert serve.request(port, {"op": "stats"})["ok"]
+        assert serve.request(port, {"op": "register", "part": 1,
+                                    "replica": 0, "addr": "127.0.0.1",
+                                    "port": 2})["ok"]
+    finally:
+        server.stop()
+        core.close()
+
+
+# ----------------------------------------------------------------------------
+# the bitwise contract: 2 sharded backends == one single-host server
+# ----------------------------------------------------------------------------
+
+def test_two_backend_fleet_bitwise_vs_single_host():
+    g, cfg, params, state, hidden, logits, owner = _setup()
+    ref = serve.build_core(cfg, g, params, state, log=lambda *a: None,
+                           hidden=hidden, logits=logits)
+    fleet = _Fleet2()
+    try:
+        # tier A: routed lookup == the single-host table row, bitwise
+        probe = [0, 1, 7, 123, g.n_nodes - 1]
+        for v in probe:
+            routed = fleet.rcore.predict(v)
+            local = ref.predict(v)
+            assert routed["tier"] == "A"
+            assert routed["scores"] == local["scores"]
+            assert routed["part"] == owner[v]
+            assert routed["backend"] == f"p{owner[v]}.r0"
+        many = fleet.rcore.predict_many(probe)
+        assert [r["scores"] for r in many] == \
+               [ref.predict(v)["scores"] for v in probe]
+
+        # a cross-part edge delta: u and v owned by different parts, so the
+        # apply fans to both, the mark BFS crosses the boundary, and tier-B
+        # closures need remote halo rows
+        u = int(np.flatnonzero(owner == 0)[3])
+        v = int(np.flatnonzero(owner == 1)[3])
+        edges = [[u, v], [v, u]]
+        r = fleet.rcore.add_edges(edges)
+        ref_r = ref.add_edges(edges)
+        assert r["ok"]
+        # identical dirty frontier: the distributed mark BFS covers exactly
+        # the single-host forward closure
+        fleet_dirty = set()
+        for c in fleet.cores:
+            with c._lock:
+                fleet_dirty |= c.dirty
+        assert fleet_dirty == ref.dirty
+        assert r["dirty_total"] == ref_r["dirty_total"]
+
+        # tier B on dirty nodes (both sides of the cut): bitwise — same
+        # closure, same edge order, same compiled program
+        dirty_probe = sorted(ref.dirty)[:4] + [u, v]
+        for w in set(dirty_probe):
+            routed = fleet.rcore.predict(w)
+            local = ref.predict(w)
+            assert routed["tier"] == local["tier"] == "B", f"node {w}"
+            assert routed["scores"] == local["scores"], f"node {w}"
+
+        # post-delta refresh: flush both, then tier A again — bitwise.
+        # The tier-B predicts above already refreshed their targets, so the
+        # remaining counts must agree with the single-host server's.
+        assert fleet.rcore.flush() == ref.flush()
+        assert fleet.rcore._dirty_total() == 0
+        for w in set(dirty_probe):
+            routed = fleet.rcore.predict(w)
+            local = ref.predict(w)
+            assert routed["tier"] == local["tier"] == "A", f"node {w}"
+            assert routed["scores"] == local["scores"], f"node {w}"
+
+        # a feature update routes to the owner and dirties its closure
+        newf = np.full(g.n_feat, 0.5, dtype=np.float32)
+        fleet.rcore.update_feat(u, newf.tolist())
+        ref.update_feat(u, newf)
+        routed, local = fleet.rcore.predict(u), ref.predict(u)
+        assert routed["tier"] == local["tier"] == "B"
+        assert routed["scores"] == local["scores"]
+
+        stats = fleet.rcore.snapshot_stats()
+        assert stats["router"] and stats["parts"] == 2
+        assert len(stats["backends"]) == 2
+        assert {b["backend"] for b in stats["backends"]} == {"p0.r0", "p1.r0"}
+        assert all("halo_fetches" in b for b in stats["backends"])
+    finally:
+        fleet.close()
+        ref.close()
+
+
+def test_replica_read_consistency_and_delta_broadcast():
+    """With 2 replicas per part, a routed delta reaches BOTH replicas and
+    round-robined reads return identical bytes whichever replica answers."""
+    g, cfg, params, state, hidden, logits, owner = _setup()
+    fleet = _Fleet2(replicas=2)
+    try:
+        u = int(np.flatnonzero(owner == 0)[5])
+        v = int(np.flatnonzero(owner == 1)[5])
+        fleet.rcore.add_edges([[u, v]])
+        # every replica journaled the delta and agrees on the dirty set
+        per_part: dict = {}
+        for c in fleet.cores:
+            with c._lock:
+                per_part.setdefault(c.part, []).append(
+                    (set(c.dirty), c.stats["deltas"]))
+        for part, states in per_part.items():
+            assert states[0] == states[1], f"part {part} replicas diverged"
+        # consecutive reads hit different replicas (round-robin) yet return
+        # identical scores, before and after the refresh
+        for w in (u, v):
+            a = fleet.rcore.predict(w)
+            b = fleet.rcore.predict(w)
+            assert a["backend"] != b["backend"]
+            assert a["scores"] == b["scores"] and a["tier"] == b["tier"]
+        fleet.rcore.flush()
+        for w in (u, v):
+            a, b = fleet.rcore.predict(w), fleet.rcore.predict(w)
+            assert a["backend"] != b["backend"]
+            assert a["tier"] == b["tier"] == "A"
+            assert a["scores"] == b["scores"]
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------------
+# delta-log compaction: snapshot + tail, replay count drops on relaunch
+# ----------------------------------------------------------------------------
+
+def test_backend_compaction_snapshot_plus_tail_relaunch(tmp_path):
+    g, cfg, params, state, hidden, logits, owner = _setup()
+    serve_dir = str(tmp_path / "sdir")
+    fleet = _Fleet2(serve_dir=serve_dir, compact=4)
+    own0 = np.flatnonzero(owner == 0)
+    try:
+        # 6 routed deltas -> each backend journals >= 6 entries (apply +
+        # mark shards) and compacts past the threshold of 4
+        for i in range(6):
+            fleet.rcore.add_edges([[int(own0[i]), int(own0[i + 1])]])
+        back0 = next(c for c in fleet.cores if c.part == 0)
+        with back0._lock:
+            folded0, tail0 = back0._folded, len(back0.deltas)
+        assert folded0 >= 4                     # compaction actually ran
+        assert tail0 < folded0 + tail0          # log truncated to a tail
+        assert os.path.exists(os.path.join(serve_dir, back0._snapshot_name))
+        expect_dirty = dict()
+        for c in fleet.cores:
+            with c._lock:
+                expect_dirty[c.part] = set(c.dirty) | set(c._refreshing)
+        fleet.rcore.close()                     # drop pooled reads first
+        for s in fleet.servers:
+            s.drain(timeout_s=2.0)
+        for c in fleet.cores:
+            c.flush_delta_log(serve_dir)
+            c.close()
+
+        # relaunch both parts from the same serve_dir: the snapshot holds
+        # the folded deltas, the tail log replays the rest — replayed <
+        # total, and the dirty sets come back exactly
+        for part in (0, 1):
+            c2 = sb.build_backend_core(
+                cfg.replace(serve_part=part), g, owner, params, state,
+                log=lambda *a: None, hidden=hidden, logits=logits)
+            c2.serve_dir = serve_dir
+            counts = c2.load_serving_state(serve_dir)
+            try:
+                if part == 0:
+                    assert counts["folded"] == folded0
+                    assert counts["replayed"] == tail0
+                assert counts["folded"] >= 4 or part != 0
+                with c2._lock:
+                    assert set(c2.dirty) == expect_dirty[part]
+            finally:
+                c2.close()
+    finally:
+        for r in fleet.resolvers:
+            r.close()
+        fleet.router.drain(timeout_s=2.0)
+
+
+def test_pooled_client_survives_server_side_idle_drop():
+    """LineJsonClient (the router's pooled read path) reconnects once on a
+    torn pooled connection — the coord handler drops idle connections at
+    its 10 s read timeout, and an evicted pool entry must look like one
+    transparent retry, not an error."""
+    got = []
+
+    def handler(req):
+        got.append(req)
+        return {"ok": True, "n": len(got)}
+
+    srv = coord.LineJsonServer(0, handler).start()
+    try:
+        cli = coord.LineJsonClient("127.0.0.1", srv.port, timeout_s=5.0)
+        assert cli.request({"op": "a"})["n"] == 1
+        assert cli.request({"op": "b"})["n"] == 2   # same pooled connection
+        # kill the server socket under the pooled client, then restart on
+        # the SAME port: the retry path must transparently reconnect
+        port = srv.port
+        srv.stop()
+        srv = coord.LineJsonServer(port, handler).start()
+        assert cli.request({"op": "c"})["ok"]
+        cli.close()
+        with pytest.raises(coord.CoordTimeout, match="unreachable"):
+            dead = coord.LineJsonClient("127.0.0.1", 1, timeout_s=0.5,
+                                        what="nobody")
+            dead.request({"op": "x"})
+    finally:
+        srv.stop()

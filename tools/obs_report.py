@@ -23,6 +23,10 @@ Sections (each rendered only when the log carries its events):
   * cross-rank epochs — rank 0's merged `epoch_ranks` records (the
     piggybacked agree_step summaries)
   * serving — per-tier p50/p99 + refresh lag from `serve_drain`
+  * serving fleet — per-backend tier splits + router fan-out counts when
+    the log carries sharded-serving events (`serve_drain` records tagged
+    with a backend id, plus the router's `serve_fleet` drain record; the
+    backends' `.rN` sibling logs merge in via the same auto-discovery)
   * bench — per-variant epoch times from a bench.py --obs-log
 
 --compare prints an epoch-aligned loss/step diff plus the header deltas —
@@ -85,6 +89,7 @@ def summarize(events: list[dict]) -> dict:
     """Structured digest of one run's events (the --json output)."""
     out: dict = {"header": None, "epochs": {}, "evals": {}, "lifecycle": [],
                  "epoch_ranks": [], "serve": None, "serve_header": None,
+                 "serve_drains": [], "serve_fleet": None,
                  "run_end": None, "traces": [], "bench": [], "audits": [],
                  "unknown_kinds": {}}
     for ev in events:
@@ -106,7 +111,15 @@ def summarize(events: list[dict]) -> dict:
         elif k == "epoch_ranks":
             out["epoch_ranks"].append(ev)
         elif k == "serve_drain":
-            out["serve"] = ev
+            out["serve_drains"].append(ev)
+            # the single-host slot keeps its pre-fleet meaning: backend
+            # shards tag their drains with a backend id, the single-host
+            # server does not — existing consumers of "serve" see exactly
+            # what they saw before sharded serving existed
+            if "backend" not in ev:
+                out["serve"] = ev
+        elif k == "serve_fleet":
+            out["serve_fleet"] = ev
         elif k == "serve_header":
             out["serve_header"] = ev
         elif k == "run_end" and int(ev.get("rank", 0)) == 0:
@@ -356,6 +369,36 @@ def render(s: dict, write=print):
               f"{sv.get('tier_b_p50_ms')} ms p99 {sv.get('tier_b_p99_ms')} ms")
         write(f"  refresh lag p50 {sv.get('refresh_lag_p50_s')} s p99 "
               f"{sv.get('refresh_lag_p99_s')} s")
+    shards = [ev for ev in s.get("serve_drains", []) if "backend" in ev]
+    fleet = s.get("serve_fleet")
+    if shards or fleet is not None:
+        write("")
+        write("serving fleet:")
+        if fleet is not None:
+            write(f"  router: {fleet.get('requests')} requests routed "
+                  f"(A {fleet.get('tier_a')} / B {fleet.get('tier_b')}) | "
+                  f"{fleet.get('deltas')} deltas over "
+                  f"{fleet.get('fanout_rpcs')} fan-out RPCs | "
+                  f"{fleet.get('evictions')} evictions | "
+                  f"{fleet.get('parts')}x{fleet.get('replicas')} "
+                  f"parts x replicas"
+                  + (f" | {fleet.get('shutdown_acked')} shutdown ack(s)"
+                     if fleet.get("shutdown_acked") is not None else ""))
+        if shards:
+            write("  backend   req(A/B)        A p50/p99 ms    "
+                  "B p50/p99 ms    lag p99 s  queue  halo hit/fetch")
+        for ev in sorted(shards, key=lambda e: (_num(e.get("part")),
+                                                _num(e.get("replica")))):
+            reqs = (f"{ev.get('requests')}"
+                    f"({ev.get('tier_a')}/{ev.get('tier_b')})")
+            write(f"  {ev.get('backend', '?'):<8}  {reqs:<14}  "
+                  f"{_num(ev.get('tier_a_p50_ms')):6.2f}/"
+                  f"{_num(ev.get('tier_a_p99_ms')):<7.2f}  "
+                  f"{_num(ev.get('tier_b_p50_ms')):6.2f}/"
+                  f"{_num(ev.get('tier_b_p99_ms')):<7.2f}  "
+                  f"{_num(ev.get('refresh_lag_p99_s')):9.3f}  "
+                  f"{ev.get('queue_depth', '-'):>5}  "
+                  f"{ev.get('halo_hits', 0)}/{ev.get('halo_fetches', 0)}")
     if s["bench"]:
         write("")
         write("bench variants:")

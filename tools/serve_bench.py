@@ -23,8 +23,20 @@ serving, not one-time XLA compiles. A previously-unseen bucket shape can
 still appear mid-measurement (closure sizes vary); raise --warmup if tier-B
 p99 looks compile-shaped.
 
+Fleet mode (--fleet N): self-hosts a partition-sharded serving fleet
+instead — N per-part backends (random N-way owner map over the same
+synthetic graph) behind a real serve-router, all over real TCP — and fires
+the same workload at the ROUTER. Responses carry their shard tags, so the
+percentiles additionally split per part/backend, the server-side
+cross-check runs per backend against the router's aggregated `stats`, and
+a direct-at-the-backend tier-A pass measures the router's forwarding
+overhead (routed p50 / direct p50 — flagged when it exceeds 2x). --variant
+tags every emitted metric line (default: serve1 single-host, serve{N}p
+fleet) so bench.py can record both topologies side by side.
+
 Usage: python tools/serve_bench.py [--requests 400] [--concurrency 4]
-           [--dataset synthetic] [--model graphsage] [--json-only]
+           [--dataset synthetic] [--model graphsage] [--fleet 2]
+           [--json-only]
 """
 
 from __future__ import annotations
@@ -77,6 +89,13 @@ def parse_args(argv=None):
     p.add_argument("--port", type=int, default=0,
                    help="external server port (with --addr); 0 self-hosts "
                         "on a free port")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="self-host a partition-sharded fleet: N per-part "
+                        "backends behind a serve-router, bench the router; "
+                        "0 = single-host ServeServer")
+    p.add_argument("--variant", default="",
+                   help="topology tag on every emitted metric line "
+                        "(default: serve1, or serve{N}p with --fleet)")
     p.add_argument("--json-only", action="store_true")
     return p.parse_args(argv)
 
@@ -98,6 +117,64 @@ def _self_host(args, log):
     return server, core
 
 
+def _self_host_fleet(args, log):
+    """(router_server, close_fn, n_nodes, owned): a real serve-router
+    fronting --fleet in-process per-part backends (random owner map, one
+    full-table precompute sliced into shards), all over real TCP. `owned`
+    maps backend id -> (direct port, owned node ids) for the direct
+    overhead pass."""
+    from bnsgcn_tpu import serve_backend as sb
+    from bnsgcn_tpu import serve_router as sr
+    from bnsgcn_tpu.evaluate import full_graph_embeddings
+    cfg = Config(dataset=args.dataset, model=args.model,
+                 n_layers=args.layers, n_hidden=args.hidden,
+                 seed=args.seed, serve_max_batch=args.max_batch,
+                 use_pp=args.model == "graphsage")
+    g, _, _ = load_data(cfg)
+    cfg = cfg.replace(n_feat=g.n_feat, n_class=g.n_class, n_train=g.n_train)
+    spec = spec_from_config(cfg)
+    params, state = init_params(jax.random.key(args.seed), spec)
+    log(f"graph: {g.n_nodes} nodes, {g.n_edges} edges | model {args.model} "
+        f"L={args.layers} H={args.hidden} | fleet of {args.fleet} part(s)")
+    t0 = time.perf_counter()
+    hidden, logits = full_graph_embeddings(params, state, spec, g,
+                                           cfg.edge_chunk)
+    hidden, logits = np.asarray(hidden), np.asarray(logits)
+    log(f"full table precomputed once in {time.perf_counter() - t0:.1f}s; "
+        f"sliced into {args.fleet} shards")
+    rng = np.random.default_rng(args.seed)
+    owner = rng.integers(0, args.fleet, size=g.n_nodes).astype(np.int32)
+    owner[:args.fleet] = np.arange(args.fleet)      # every part non-empty
+    rcore = sr.RouterCore(owner, args.fleet, hops=spec.n_graph_layers,
+                          log=log)
+    router = sr.RouterServer(rcore, 0, log=log)
+    cores, servers, resolvers, owned = [], [], [], {}
+    for part in range(args.fleet):
+        c = sb.build_backend_core(cfg.replace(serve_part=part), g, owner,
+                                  params, state, log=lambda *a, **k: None,
+                                  hidden=hidden, logits=logits)
+        s = sb.BackendServer(c, 0, log=log)
+        res = sb.PeerResolver("127.0.0.1", router.port)
+        c.graph.resolver = res
+        rcore.fleet.register(part, 0, "127.0.0.1", s.port)
+        cores.append(c)
+        servers.append(s)
+        resolvers.append(res)
+        owned[f"p{part}.r0"] = (s.port, np.flatnonzero(owner == part))
+
+    def close():
+        for s in servers:
+            s.drain(timeout_s=5.0)
+        for c in cores:
+            c.close()
+        for r in resolvers:
+            r.close()
+        router.drain(timeout_s=5.0)
+        rcore.close()
+
+    return router, close, g.n_nodes, owned
+
+
 def _fire(args, port, addr, tier, nodes, latencies, errors):
     for n in nodes:
         req = {"op": "predict", "node": int(n)}
@@ -110,7 +187,8 @@ def _fire(args, port, addr, tier, nodes, latencies, errors):
         if not resp.get("ok"):
             errors.append(resp.get("err", "?"))
         else:
-            latencies.append(dt)
+            # a routed response carries its shard tag — the fleet split
+            latencies.append((dt, resp.get("backend")))
 
 
 def _burst(args, port, addr, tier, rng, n_nodes, per, lat, errors):
@@ -137,7 +215,7 @@ def bench_tier(args, port, addr, tier, n_nodes, log):
     _burst(args, port, addr, tier, rng, n_nodes,
            max(args.warmup // args.concurrency, 1), [], [])
     per = max(args.requests // args.concurrency, 1)
-    lat: list[float] = []
+    lat: list[tuple] = []
     errors: list[str] = []
     t0 = time.perf_counter()
     _burst(args, port, addr, tier, rng, n_nodes, per, lat, errors)
@@ -146,20 +224,75 @@ def bench_tier(args, port, addr, tier, n_nodes, log):
         raise RuntimeError(f"tier {tier}: {len(errors)} failed requests "
                            f"(first: {errors[0]})")
     qps = len(lat) / wall / max(jax.device_count(), 1)
-    p50, p99 = np.percentile(lat, [50, 99])
+    p50, p99 = np.percentile([d for d, _ in lat], [50, 99])
     log(f"tier {tier}: {len(lat)} requests in {wall:.2f}s | p50 "
         f"{p50:.3f} ms p99 {p99:.3f} ms | {qps:.1f} req/s/chip")
-    return float(p50), float(p99), float(qps)
+    # per-part/backend split (routed responses only): where the time goes
+    # when one shard runs hotter than the rest
+    by_backend: dict[str, list[float]] = {}
+    for d, bid in lat:
+        if bid:
+            by_backend.setdefault(bid, []).append(d)
+    split = {}
+    for bid in sorted(by_backend):
+        bp50, bp99 = np.percentile(by_backend[bid], [50, 99])
+        split[bid] = (float(bp50), float(bp99), len(by_backend[bid]))
+        log(f"  tier {tier} @ {bid}: n={len(by_backend[bid])} p50 "
+            f"{bp50:.3f} ms p99 {bp99:.3f} ms")
+    return float(p50), float(p99), float(qps), split
+
+
+def _direct_overhead(args, routed_a_p50, owned, log):
+    """Routed-vs-direct tier-A overhead: fire at ONE backend directly (its
+    owned nodes — anything else is a mis-route by construction) and compare
+    medians. The router adds one hop + one line-JSON re-encode; more than
+    2x on the tier-A median means the routing layer, not the model, owns
+    the latency budget."""
+    bid, (bport, bnodes) = sorted(owned.items())[0]
+    rng = np.random.default_rng(args.seed + 7)
+    lat: list[tuple] = []
+    errors: list[str] = []
+    per = max(args.requests // args.concurrency, 8)
+    threads = []        # SAME concurrency as the routed pass — queueing
+    for _ in range(args.concurrency):       # must hit both sides equally
+        picks = bnodes[rng.integers(0, len(bnodes), size=per)]
+        t = threading.Thread(target=_fire, args=(args, bport, "127.0.0.1",
+                                                 "A", picks, lat, errors))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    if errors:
+        raise RuntimeError(f"direct pass at {bid}: {len(errors)} failed "
+                           f"(first: {errors[0]})")
+    direct_p50 = float(np.percentile([d for d, _ in lat], 50))
+    ratio = routed_a_p50 / max(direct_p50, 1e-9)
+    log(f"router overhead: tier A p50 routed {routed_a_p50:.3f} ms vs "
+        f"direct @ {bid} {direct_p50:.3f} ms -> {ratio:.2f}x")
+    if ratio > 2.0:
+        log(f"  WARNING: routed tier-A p50 is {ratio:.2f}x the direct-"
+            f"backend p50 (budget: 2x) — the router hop dominates")
+    return direct_p50, ratio
 
 
 def main(argv=None):
     args = parse_args(argv)
     log = (lambda *a, **k: None) if args.json_only else print
-    server = core = None
+    variant = args.variant or (f"serve{args.fleet}p" if args.fleet
+                               else "serve1")
+    tags = {"variant": variant, "backends": args.fleet or 1}
+    server = core = close_fleet = None
+    owned: dict = {}
     if args.addr:
         port, addr = args.port, args.addr
         n_nodes = int(serve.request(port, {"op": "stats"},
                                     addr=addr)["n_nodes"])
+    elif args.fleet:
+        t0 = time.perf_counter()
+        router, close_fleet, n_nodes, owned = _self_host_fleet(args, log)
+        port, addr = router.port, "127.0.0.1"
+        log(f"self-hosted fleet up behind router port {port} "
+            f"({time.perf_counter() - t0:.1f}s incl. table precompute)")
     else:
         t0 = time.perf_counter()
         server, core = _self_host(args, log)
@@ -178,7 +311,10 @@ def main(argv=None):
         # clocks disagree about where the time goes. p50 ONLY: the server's
         # histogram also holds the warmup pass (its one-time bucket compiles
         # dominate a tail quantile but cannot move the median), so its p99
-        # is printed for context, not compared.
+        # is printed for context, not compared. Against a router, the same
+        # keys hold the ROUTE-level percentiles, and the nested `backends`
+        # stats run the check once per backend against its client-side
+        # split.
         stats = serve.request(port, {"op": "stats"}, addr=addr or "127.0.0.1")
         for tier in ("A", "B"):
             sp50 = stats.get(f"tier_{tier.lower()}_p50_ms", 0.0)
@@ -190,22 +326,37 @@ def main(argv=None):
             if sp50 > cp50 * 1.5 + 0.5:
                 log(f"  WARNING: tier {tier} server p50 exceeds client p50 "
                     f"— registry/clock skew, treat percentiles as suspect")
+            for be in stats.get("backends", []):
+                bid = be.get("backend", "?")
+                bsp50 = be.get(f"tier_{tier.lower()}_p50_ms", 0.0)
+                bcp50 = results[tier][3].get(bid, (0.0,))[0]
+                log(f"  tier {tier} @ {bid} server-side p50 {bsp50:.3f} ms "
+                    f"(client-side {bcp50:.3f} ms)")
+                if bcp50 and bsp50 > bcp50 * 1.5 + 0.5:
+                    log(f"  WARNING: tier {tier} @ {bid} server p50 exceeds "
+                        f"its client p50 — registry/clock skew, treat "
+                        f"percentiles as suspect")
+        if owned:
+            _, ratio = _direct_overhead(args, results["A"][0], owned, log)
+            tags["router_overhead_x"] = round(ratio, 3)
         for tier in ("A", "B"):
-            p50, p99, qps = results[tier]
-            emit_serve_metric("serve_p50_ms", p50, tier=tier)
-            emit_serve_metric("serve_p99_ms", p99, tier=tier)
-            emit_serve_metric("serve_qps", qps, tier=tier)
+            p50, p99, qps, _ = results[tier]
+            emit_serve_metric("serve_p50_ms", p50, tier=tier, **tags)
+            emit_serve_metric("serve_p99_ms", p99, tier=tier, **tags)
+            emit_serve_metric("serve_qps", qps, tier=tier, **tags)
         # last line wins for the driver: the mixed-fleet headline is tier-A
         # throughput (the tier a production cache-hit path serves)
         emit_serve_metric("serve_qps", results["A"][2], tier="A",
                           requests=args.requests,
-                          concurrency=args.concurrency)
+                          concurrency=args.concurrency, **tags)
         assert set(SERVE_METRICS) == {"serve_p50_ms", "serve_p99_ms",
                                       "serve_qps"}
     finally:
         if server is not None:
             server.drain(timeout_s=5.0)
             core.close()
+        if close_fleet is not None:
+            close_fleet()
     return 0
 
 
