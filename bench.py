@@ -942,6 +942,13 @@ def main():
                     cfg = cfg.replace(**_ch)
         return cfg
 
+    # graftperf (analysis/perf) predictions per candidate name — filled by
+    # setup_and_compile from the BUILT layout, joined to the measurement
+    # in the gated loop below so every bench record doubles as
+    # calibration data (predicted_step_s / predicted_wire_mb + the
+    # residual log line)
+    perf_pred = {}
+
     # +ro candidates run on the PERMUTED artifact (what run.py's
     # maybe_reorder produces) — the perm depends on the tile size, so
     # memoize one reordered artifact per tile value across candidates
@@ -978,6 +985,56 @@ def main():
                 f"{g.n_edges / 1e6:.1f}M edges in dense tiles "
                 f"({dc / g.n_edges:.0%})")
         log(f"  {spmm} layouts in {time.time() - t0:.1f}s")
+        # roofline prediction from the layout that actually built (tile
+        # stacks, ELL geometry, halo geometry) — best-effort: a prediction
+        # failure must never cost a tunnel-window measurement
+        try:
+            from bnsgcn_tpu.analysis.perf import calibration as pcal
+            from bnsgcn_tpu.analysis.perf import model as pmod
+            table = pcal.backend_table(pcal.load_calibration(),
+                                       jax.default_backend())
+            nbytes = 2 if cfg.dtype == "bfloat16" else 4
+            gb = {"int8": 1, "fp8": 1}.get(variant[2], nbytes)
+            slots_full = 0.0
+            tiles = 0
+            if v_art.ell_geometry:
+                slots_full = 0.5 * (
+                    pmod.ell_geometry_slots(v_art.ell_geometry, "fwd")
+                    + pmod.ell_geometry_slots(v_art.ell_geometry, "bwd"))
+            fill = (v_art.pad_edges / slots_full) if slots_full else 0.74
+            if spmm == "hybrid":
+                from bnsgcn_tpu.ops.block_spmm import dense_edge_count
+                dcov = dense_edge_count(fns.extra_blk) / max(g.n_edges, 1)
+                for tkey in ("blk_tiles_fwd", "int_blk_tiles_fwd",
+                             "fro_blk_tiles_fwd"):
+                    t_arr = fns.extra_blk.get(tkey)
+                    if t_arr is not None:
+                        tiles += int(np.asarray(t_arr).shape[1])
+                slots = (v_art.pad_edges * max(1.0 - dcov, 0.0)
+                         / max(fill, 1e-9))
+            else:
+                slots = slots_full or float(v_art.pad_edges)
+            width = max(cfg.n_hidden // max(_vfeat(variant), 1), 1)
+            wire_mb = pmod.steady_wire_mb(
+                v_art.n_b, v_art.pad_boundary, cfg.sampling_rate,
+                strategy=_vhalo(variant), wire="native",
+                refresh=_vhr(variant), width=width,
+                native_bytes=nbytes) * 2 * max(cfg.n_layers - 1, 1)
+            feat_p = pmod.StepFeatures(
+                n_apps=2 * int(cfg.n_layers), gather_slots=float(slots),
+                row_bytes=int(cfg.n_hidden) * gb,
+                gather_path="materialize",
+                dense_tiles=tiles, tile=int(variant[4]),
+                dense_path=(("pallas" if variant[1] else "xla")
+                            if tiles else "none"),
+                wire_mb=wire_mb)
+            perf_pred[_vname(variant)] = {
+                "predicted_step_s": round(
+                    pmod.predict_step_s(feat_p, table), 4),
+                "predicted_wire_mb": round(wire_mb, 4)}
+        except Exception as ex:  # pragma: no cover - prediction is optional
+            log(f"  [perf] prediction unavailable for {_vname(variant)}: "
+                f"{type(ex).__name__}: {ex}")
         blk_np = build_block_arrays(v_art, spec.model)
         blk_np.update(fns.extra_blk)
         for k in fns.drop_blk_keys:
@@ -1355,11 +1412,20 @@ def main():
             # gate its quantized twins are judged against
             native_l0[base], native_lf[base] = l0, lf
         log(f"  spmm={name}: {et:.4f}s/epoch loss={lf:.4f}")
+        pred = perf_pred.get(name) or {}
+        if pred:
+            # the residual line: the perf trajectory doubles as
+            # calibration data from here on (gate 4 audits the drift)
+            log(f"  [perf] {name}: predicted "
+                f"{pred['predicted_step_s']:.4f}s/epoch "
+                f"({(pred['predicted_step_s'] - et) / max(et, 1e-9):+.1%} "
+                f"residual), steady wire "
+                f"{pred['predicted_wire_mb']:.2f} MB/epoch")
         if obs_ev is not None:
             obs_ev.emit("bench_variant", name=name, epoch_s=round(et, 4),
                         min_epoch_s=round(mt, 4), loss=round(lf, 4),
                         backend=jax.default_backend(),
-                        profiled=bool(args.profile_dir))
+                        profiled=bool(args.profile_dir), **pred)
         try:
             # structured per-candidate history (append-only) — the winner
             # JSON line only carries the best, but cross-window analysis
@@ -1375,7 +1441,7 @@ def main():
                     "profiled": bool(args.profile_dir),
                     # the obs-log path makes this measurement post-hoc
                     # auditable: obs_report --compare two windows' logs
-                    **obs_extra}) + "\n")
+                    **pred, **obs_extra}) + "\n")
         except Exception:
             pass
         if best is None or et < best[0]:

@@ -13,6 +13,12 @@ coordination-protocol model checker (analysis/proto): the real
 Coordinator/ResilienceManager code under a deterministic scheduler,
 across enumerated interleavings and fault schedules. Same exit-code
 contract: 0 clean, 1 findings, 2 scenarios failed to explore.
+
+``python -m bnsgcn_tpu.analysis perf`` runs the fourth tier — the
+predictive roofline audit (analysis/perf): calibration schema, drift of
+the model against the repo's recorded measurements, monotonicity, and a
+priced sweep of every tune-reachable lever state. Same exit-code
+contract: 0 clean, 1 findings, 2 cells failed to evaluate.
 """
 
 from __future__ import annotations
@@ -172,6 +178,76 @@ def proto_main(argv) -> int:
     return 1 if report["findings"] else 0
 
 
+def perf_main(argv) -> int:
+    """The `perf` subcommand: audit the cost model against the recorded
+    history + price the lever matrix. Pure host arithmetic (the halo
+    geometry is mirrored in numpy), but the variant enumeration imports
+    the live config — force CPU like the other preflight tiers so a
+    stray jax init can never grab a queued device."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="python -m bnsgcn_tpu.analysis perf",
+        description="graftperf — predictive roofline audit: calibration "
+                    "schema, drift vs recorded measurements, "
+                    "monotonicity, and wire/step pricing of every "
+                    "tune-reachable lever state")
+    ap.add_argument("--root", default=None,
+                    help="repo root for the report (default: inferred)")
+    ap.add_argument("--json", dest="json_path", default=None, metavar="PATH",
+                    help="write the machine-readable report here "
+                         "('-' for stdout)")
+    ap.add_argument("--calibration", default=None, metavar="PATH",
+                    help="calibration tables to audit (default: "
+                         "tools/perf_calibration.json)")
+    ap.add_argument("--tune-schedule", default=None, metavar="SPEC",
+                    help="also price the lever states this --tune-schedule "
+                         "string reaches")
+    ap.add_argument("--check-obs", default=None, metavar="PATH",
+                    help="additionally audit this obs log's epoch wire_mb "
+                         "records against their run_header/tune_decision "
+                         "declarations")
+    ap.add_argument("--drift-band", type=float, default=None, metavar="F",
+                    help="override the prediction drift band "
+                         "(default 0.25 = ±25%%)")
+    ap.add_argument("--obs-log", default=None, metavar="PATH",
+                    help="land the perf_audit event on this telemetry log "
+                         "(default: $BNSGCN_OBS_LOG)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress per-variant progress lines")
+    args = ap.parse_args(argv)
+
+    from bnsgcn_tpu.analysis.perf import DRIFT_BAND, run_perf_audit
+    progress = None if args.quiet else (
+        lambda msg: print(msg, file=sys.stderr))
+    report = run_perf_audit(
+        root=args.root, calibration=args.calibration,
+        tune_schedule=args.tune_schedule, check_obs=args.check_obs,
+        obs_log=args.obs_log, progress=progress,
+        drift_band=(DRIFT_BAND if args.drift_band is None
+                    else args.drift_band))
+
+    for f in report["findings"]:
+        print(f"{f['file']}: [{f['rule']}] {f['message']}")
+        hint = RULE_DOCS.get(f["rule"], ("", ""))[1]
+        if hint:
+            print(f"    fix: {hint}")
+
+    if args.json_path == "-":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    elif args.json_path:
+        write_report(report, args.json_path)
+
+    tag = "clean" if report["ok"] else "FAIL"
+    print(f"graftperf: {tag} — {report['n_records']} record(s), "
+          f"{report['n_variants']} variant(s) in {report['elapsed_s']}s, "
+          f"{len(report['findings'])} finding(s), "
+          f"{len(report['errors'])} eval error(s)", file=sys.stderr)
+    if report["errors"]:
+        return 2
+    return 1 if report["findings"] else 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -179,6 +255,8 @@ def main(argv=None) -> int:
         return ir_main(argv[1:])
     if argv and argv[0] == "proto":
         return proto_main(argv[1:])
+    if argv and argv[0] == "perf":
+        return perf_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m bnsgcn_tpu.analysis",
         description="graftlint — SPMD-aware static analysis for this repo")

@@ -199,6 +199,42 @@ RULE_DOCS = {
         "not a protocol verdict)",
         "reproduce with `python -m bnsgcn_tpu.analysis proto --scenario "
         "<name>` and fix the exception before trusting the audit"),
+    # -- family 11: predictive cost model (analysis/perf, `perf`
+    #    subcommand). Findings attribute to perf://<record|variant|probe>. --
+    "perf-calibration-invalid": (
+        "the perf calibration table fails schema/physics validation "
+        "(missing backend constants, non-positive rates, records "
+        "referencing unknown backends or feature fields)",
+        "fix tools/perf_calibration.json by hand or regenerate the "
+        "backend table with `python tools/microbench.py "
+        "--emit-calibration out.json` on the target backend"),
+    "perf-model-drift": (
+        "cost-model prediction off a recorded measurement beyond the "
+        "drift band — the model no longer explains the repo's own "
+        "perf history",
+        "recalibrate the backend table (microbench --emit-calibration, "
+        "or model.fit_scale over fresh obs epochs) or fix the record's "
+        "layout features; never widen the band to make it pass"),
+    "perf-model-nonmonotone": (
+        "the cost model violated a physical ordering (more wire or less "
+        "dense coverage predicted faster, gather sped up with row "
+        "bytes, coarser refresh shipped more steady bytes, or a lever "
+        "state priced non-finite)",
+        "the roofline terms in analysis/perf/model.py regressed — a "
+        "model that can rank backwards will mistune --tune-prior and "
+        "misrank the watch queue; fix the term, don't gate it off"),
+    "perf-obs-drift": (
+        "an obs epoch record's wire_mb matches no figure its "
+        "run_header/tune_decision events declared",
+        "run.py's per-epoch wire accounting and its header/tune "
+        "declarations diverged — align epoch_wire_mb with "
+        "halo.wire_bytes over the live spec before trusting the "
+        "K-vs-bytes history"),
+    "perf-audit-error": (
+        "a perf-audit cell failed to evaluate at all (harness error, "
+        "not a model verdict)",
+        "reproduce with `python -m bnsgcn_tpu.analysis perf` and fix "
+        "the exception before trusting the gate"),
     # -- framework --
     "suppression-stale": (
         "graftlint: disable= comment whose line no longer triggers any "

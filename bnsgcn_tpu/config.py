@@ -181,6 +181,14 @@ class Config:
                                         # separated lever=value@epoch, levers
                                         # K/mode/strategy/wire (e.g.
                                         # 'K=4@0,K=2@30,K=1@60,wire=bf16@30')
+    tune_prior: str = "ladder"          # --tune auto launch point: 'ladder'
+                                        # (coarse K=4 start, tighten rung by
+                                        # rung — the historical controller,
+                                        # bit-identical default) | 'model'
+                                        # (the graftperf cost model
+                                        # (analysis/perf) predicts the comm
+                                        # fraction and picks the starting
+                                        # rung, then auto refines locally)
     overlap: str = "off"                # 'off' (fused exchange-then-aggregate; the
                                         # historical step graph) | 'split' (interior/
                                         # frontier row-split aggregation: the halo
@@ -421,6 +429,13 @@ def create_parser() -> argparse.ArgumentParser:
          help="--tune schedule grammar: comma-separated lever=value@epoch "
               "with levers K/mode/strategy/wire, e.g. "
               "'K=4@0,K=2@30,K=1@60,wire=bf16@30'")
+    both("tune-prior", type=str, default="ladder",
+         choices=["ladder", "model"],
+         help="--tune auto launch point: 'ladder' starts coarse (K=4) and "
+              "tightens rung by rung; 'model' asks the graftperf cost model "
+              "(analysis/perf) for the predicted-optimal starting rung from "
+              "the partition geometry + calibration tables, then refines "
+              "locally — fewer retune windows when the model is right")
     p.add_argument("--overlap", type=str, default="off", choices=["off", "split"])
     both("streaming-artifacts", type=str, default="auto",
          choices=["auto", "always", "never"])

@@ -74,6 +74,9 @@ EVENT_KINDS = (
     # protocol model-checking preflight (analysis/proto,
     # `-m bnsgcn_tpu.analysis proto`)
     "proto_audit",
+    # predictive cost-model audit (analysis/perf, `-m bnsgcn_tpu.analysis
+    # perf`)
+    "perf_audit",
 )
 
 

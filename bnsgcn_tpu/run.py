@@ -279,7 +279,34 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     tune_mod.validate_mode(cfg, multi_host=multi_host,
                            coordinated=coordinator is not None)
     if cfg.tune != "off":
-        _ch0, _why0 = tune_mod.startup_changes(cfg)
+        _prior = None
+        if cfg.tune == "auto" and cfg.tune_prior == "model":
+            # --tune-prior model: the graftperf roofline (analysis/perf)
+            # predicts the comm fraction from the partition geometry +
+            # calibration tables and picks the launch rung, skipping
+            # ladder rungs whose wire saving it prices as immaterial. A
+            # prediction failure must never kill a run: fall back to the
+            # default coarse start and say so.
+            try:
+                import jax as _jax
+
+                from bnsgcn_tpu.analysis.perf import calibration as _pcal
+                from bnsgcn_tpu.analysis.perf import model as _pmod
+                _table = _pcal.backend_table(_pcal.load_calibration(),
+                                             _jax.default_backend())
+                _strat = (cfg.halo_exchange if cfg.halo_exchange in
+                          ("padded", "shift", "ragged") else "padded")
+                _feat = _pmod.run_features(cfg, art, strategy=_strat)
+                _prior = _pmod.model_prior(_feat, _table,
+                                           comm_frac=tune_mod.AUTO_COMM_FRAC)
+                log(f"[tune] prior: predicted step "
+                    f"{_prior['step_s'] * 1e3:.1f} ms, wire "
+                    f"{_prior['wire_s'] * 1e3:.2f} ms "
+                    f"(comm {_prior['comm_frac']:.1%})")
+            except Exception as ex:
+                log(f"[tune] model prior unavailable "
+                    f"({type(ex).__name__}: {ex}); using ladder start")
+        _ch0, _why0 = tune_mod.startup_changes(cfg, prior=_prior)
         if _ch0:
             cfg = cfg.replace(**_ch0)
             _tune_start = (_ch0, _why0)
@@ -467,7 +494,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 "heads", "sampling_rate", "lr", "dtype", "spmm",
                 "use_pallas", "spmm_gather", "spmm_dense", "halo_exchange",
                 "halo_wire", "halo_refresh", "halo_mode", "overlap",
-                "reorder", "tune", "tune_schedule",
+                "reorder", "tune", "tune_schedule", "tune_prior",
                 "n_epochs", "log_every", "seed",
                 "inductive", "use_pp", "resilience", "coord")})
 
@@ -1031,10 +1058,15 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             + f" (halo {halo_label}/{hspec.wire}, steady "
               f"{steady_wire_mb:.2f} MB/exchange)")
         if obs is not None:
+            # peak rides along with steady: the forced full-refresh epoch
+            # right after a retune pays the NEW geometry's peak figure,
+            # and gate 4's obs contract checks epochs against DECLARED
+            # numbers only
             obs.emit("tune_decision", epoch=int(at_epoch), reason=reason,
                      changes=dict(changes), trigger=dict(trigger or {}),
                      halo=halo_label, wire=hspec.wire,
-                     wire_mb_steady=round(steady_wire_mb, 4))
+                     wire_mb_steady=round(steady_wire_mb, 4),
+                     wire_mb_peak=round(halo_wire_mb, 4))
 
     if tuner is not None and start_epoch > 0:
         # resumed run: reconstruct/adopt the controller history and actuate

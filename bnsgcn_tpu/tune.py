@@ -163,6 +163,13 @@ def validate_mode(cfg, multi_host: bool = False,
                           "(e.g. 'K=4@0,K=2@30,K=1@60')")
     if cfg.tune_schedule and cfg.tune != "schedule":
         raise ConfigError("--tune-schedule is only read under --tune schedule")
+    prior = getattr(cfg, "tune_prior", "ladder")
+    if prior not in ("ladder", "model"):
+        raise ConfigError(f"--tune-prior must be ladder/model, got {prior!r}")
+    if prior == "model" and cfg.tune != "auto":
+        raise ConfigError("--tune-prior model only applies to --tune auto "
+                          "(the schedule/off modes have no starting rung "
+                          "to pick)")
     if cfg.tune == "auto" and (multi_host or coordinated):
         # rank-LOCAL step timings drive auto's decisions; two ranks reading
         # different clocks would retune into different compiled programs and
@@ -174,10 +181,19 @@ def validate_mode(cfg, multi_host: bool = False,
             "for multi-rank runs")
 
 
-def startup_changes(cfg) -> tuple:
+def startup_changes(cfg, prior=None) -> tuple:
     """(changes, reason) to fold into cfg BEFORE the first build — the
-    schedule's epoch-0 entries, or auto's coarse staleness start. Empty
-    changes mean the launch config already sits at the starting point."""
+    schedule's epoch-0 entries, or auto's staleness start. Empty changes
+    mean the launch config already sits at the starting point.
+
+    `prior` (only read under --tune auto) is the graftperf model-prior
+    dict ({"halo_refresh": rung, "why": ...} from
+    analysis/perf/model.model_prior) the run computed for
+    --tune-prior model: it REPLACES the default coarse K=4 launch rung
+    with the predicted-optimal one. The fold never loosens — a user who
+    launched coarser than the pick keeps their state, exactly like the
+    default ladder start — so the prior can only skip wasted rungs,
+    never add staleness the config didn't ask for."""
     if cfg.tune == "schedule":
         for ep, levers in parse_schedule(cfg.tune_schedule):
             if ep != 0:
@@ -186,10 +202,14 @@ def startup_changes(cfg) -> tuple:
             return ch, "schedule@0"
         return {}, "schedule@0"
     if cfg.tune == "auto":
+        target = STALENESS_LADDER[1][1]
+        why = "auto-start: coarse staleness while gradients are large"
+        if prior is not None:
+            target = int(prior["halo_refresh"])
+            why = f"auto-start: {prior.get('why', 'model prior')}"
         if (cfg.halo_mode == "exchange"
-                and int(cfg.halo_refresh) < STALENESS_LADDER[1][1]):
-            return ({"halo_refresh": STALENESS_LADDER[1][1]},
-                    "auto-start: coarse staleness while gradients are large")
+                and int(cfg.halo_refresh) < target):
+            return {"halo_refresh": target}, why
         return {}, "auto-start"
     return {}, ""
 

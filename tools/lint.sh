@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# tools/lint.sh — the graftlint CI gate, all three tiers.
+# tools/lint.sh — the graftlint CI gate, all four tiers.
 #
 # Gate 1 (AST): the repo-native static-analysis suite over the default
 # lint surface (bnsgcn_tpu/, tools/, bench.py, __graft_entry__.py),
@@ -14,14 +14,20 @@
 # under a deterministic scheduler across enumerated interleavings and
 # fault schedules; report to tools/proto_report.json (override with
 # PROTO_REPORT=path).
-# Gates 2 and 3 are skipped when gate 1 fails (same signal, cheaper) or
+# Gate 4 (perf): the predictive roofline audit (`analysis perf`) —
+# calibration schema, cost-model drift against the repo's recorded
+# measurements, monotonicity, and wire/step pricing of every
+# tune-reachable lever state; report to tools/perf_report.json
+# (override with PERF_REPORT=path).
+# Gates 2-4 are skipped when gate 1 fails (same signal, cheaper) or
 # when explicit paths are passed (file-scoped lint run).
 #
 # Exit code: the first failing gate's — 0 clean, 1 findings, 2 parse/
-# trace/explore errors — straight from `python -m bnsgcn_tpu.analysis`.
+# trace/explore/eval errors — straight from `python -m bnsgcn_tpu.analysis`.
 # LINT_SKIP_IR=1 skips gate 2 (the IR tier traces ~60 programs, ~2 min
 # on a laptop CPU); LINT_SKIP_PROTO=1 skips gate 3 (~2000 schedules,
-# a few seconds).
+# a few seconds); LINT_SKIP_PERF=1 skips gate 4 (host arithmetic over
+# the calibration tables, well under a second).
 #
 # Usage:
 #   tools/lint.sh                  # full default surface, all gates
@@ -33,6 +39,7 @@ cd "$(dirname "$0")/.."
 REPORT="${LINT_REPORT:-tools/lint_report.json}"
 IR_REPORT="${IR_REPORT:-tools/ir_report.json}"
 PROTO_REPORT="${PROTO_REPORT:-tools/proto_report.json}"
+PERF_REPORT="${PERF_REPORT:-tools/perf_report.json}"
 PY="${PYTHON:-python}"
 
 # The AST tier is pure-AST (no jax import), but keep the env pinned the
@@ -66,6 +73,16 @@ if [ "$#" -eq 0 ] || { [ "$#" -eq 1 ] && [ "${1:-}" = "-q" ]; }; then
         if [ "$rc" -ne 0 ]; then
             echo "lint.sh: graftcheck-proto gate FAILED (rc=$rc, report:" \
                  "$PROTO_REPORT)" >&2
+            exit "$rc"
+        fi
+    fi
+    if [ "${LINT_SKIP_PERF:-0}" != "1" ]; then
+        JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS="" \
+            "$PY" -m bnsgcn_tpu.analysis perf --json "$PERF_REPORT" "$@"
+        rc=$?
+        if [ "$rc" -ne 0 ]; then
+            echo "lint.sh: graftperf gate FAILED (rc=$rc, report:" \
+                 "$PERF_REPORT)" >&2
             exit "$rc"
         fi
     fi

@@ -54,9 +54,9 @@ LIFECYCLE_KINDS = ("inject", "rollback", "preempt", "watchdog_fire",
                    "profile", "halo_refresh", "strict_exec",
                    "reorder", "layout_build", "tune_decision")
 
-# static-preflight verdicts (lint.sh gates 2 and 3 with --obs-log): the
+# static-preflight verdicts (lint.sh gates 2-4 with --obs-log): the
 # audit that gated a pod run sits in the same log as the run it gated
-AUDIT_KINDS = ("ir_audit", "proto_audit")
+AUDIT_KINDS = ("ir_audit", "proto_audit", "perf_audit")
 
 # the report's sub-vocabularies must stay inside the bus registry —
 # graftlint checks the emit sites, this checks the reader
@@ -226,6 +226,9 @@ def render(s: dict, write=print):
             ok = "clean" if ev.get("ok") else "FAIL"
             if ev["kind"] == "ir_audit":
                 scope = f"{ev.get('n_variants')} variant(s)"
+            elif ev["kind"] == "perf_audit":
+                scope = (f"{ev.get('n_records')} record(s) / "
+                         f"{ev.get('n_variants')} variant(s)")
             else:
                 scope = (f"{ev.get('n_schedules')} schedule(s) / "
                          f"{ev.get('n_scenarios')} scenario(s)")
@@ -402,10 +405,29 @@ def render(s: dict, write=print):
     if s["bench"]:
         write("")
         write("bench variants:")
+        has_pred = any("predicted_step_s" in ev for ev in s["bench"])
+        resids = []
         for ev in s["bench"]:
-            write(f"  {ev.get('name'):<32} {ev.get('epoch_s')} s/epoch "
-                  f"(min {ev.get('min_epoch_s')}) loss {ev.get('loss')} "
-                  f"[{ev.get('backend')}]")
+            line = (f"  {ev.get('name'):<32} {ev.get('epoch_s')} s/epoch "
+                    f"(min {ev.get('min_epoch_s')}) loss {ev.get('loss')} "
+                    f"[{ev.get('backend')}]")
+            if has_pred and "predicted_step_s" in ev:
+                p, m = _num(ev["predicted_step_s"]), _num(ev.get("epoch_s"))
+                if math.isfinite(p) and math.isfinite(m) and m > 0:
+                    resids.append(p / m - 1.0)
+                    line += (f" | predicted {p} s "
+                             f"({p / m - 1.0:+.1%} residual)")
+                else:
+                    line += f" | predicted {ev['predicted_step_s']} s"
+            write(line)
+        if resids:
+            # graftperf calibration health in one line: where the model's
+            # predictions landed against THIS log's measurements (gate 4
+            # audits the committed records; this audits the live window)
+            rs = sorted(abs(r) for r in resids)
+            write(f"  perf prediction: {len(resids)} predicted cell(s), "
+                  f"|residual| median {rs[len(rs) // 2]:.1%} "
+                  f"max {rs[-1]:.1%}")
     end = s["run_end"]
     if end is not None:
         write("")

@@ -1,0 +1,230 @@
+"""Re-rank `.watch_queue` by predicted information gain (graftperf).
+
+A short TPU tunnel window drains `.watch_queue` top-down and usually dies
+before the bottom, so the ORDER of the queue decides what the project
+learns. This tool scores every queued bench line with the analysis/perf
+roofline model:
+
+    info_gain = prediction_uncertainty x projected_speedup
+
+* projected_speedup = best measured hardware epoch (0.5715 s, round 4)
+  divided by the model's predicted epoch for the cell — candidates the
+  model thinks BEAT the ladder rank first;
+* uncertainty grows with the number of levers in the candidate that have
+  never been measured on hardware (the model extrapolates there, so a
+  measurement buys the most calibration information).
+
+Workload geometry (bench.py default: one rank's share of Reddit P=2,
+57.4M edges/chip, GraphSAGE H=256, 6 SpMM applications/step) and the
+per-graph hybrid tile coverages are the measured BENCH_NOTES constants;
+cost constants come from tools/perf_calibration.json (v5e table).
+
+Usage:
+    python tools/perf_rank.py                  # markdown ranking table
+    python tools/perf_rank.py --apply          # rewrite .watch_queue
+    python tools/perf_rank.py --pod            # papers100M 64-chip answer
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bnsgcn_tpu.analysis.perf import calibration as pcal           # noqa: E402
+from bnsgcn_tpu.analysis.perf import model as pmod                 # noqa: E402
+
+QUEUE = os.path.join(REPO, ".watch_queue")
+
+# round-4 hardware best (hybrid+pallas+unroll): the speedup denominator
+BEST_MEASURED_S = 0.5715
+
+# bench workload: 57.4M edges/chip, ELL bucket fill 0.74, 6 SpMM apps
+EDGES = 57.4e6
+FILL = 0.74
+N_APPS = 6
+# measured hybrid dense-tile coverage per bench graph (tiling_check;
+# dcsbm is the default workload). +ro rows are the PR-12 reordered
+# coverages (uniform 27->50, dcsbm-mid 46.5->68.1 at t512).
+COVERAGE = {"dcsbm": 0.758, "uniform": 0.21, "dcsbm-mid": 0.465}
+COVERAGE_RO = {"dcsbm": 0.863, "uniform": 0.50, "dcsbm-mid": 0.681}
+TILES_AT_DCSBM = 8192.0      # t512 tiles behind the 0.758 coverage
+
+# levers with a round-1..4 hardware measurement behind them; everything
+# else is extrapolation, so measuring it buys calibration information.
+# (i8g counts as NOVEL: the old reduce-path i8g lost, the queued bet is
+# the new unroll path — never timed on hardware.)
+MEASURED_LEVERS = {"ell", "hybrid", "pallas"}
+UNCERTAINTY_BASE = 0.05
+UNCERTAINTY_PER_NOVEL = 0.15
+
+
+def parse_line(line):
+    """Pull the fields that change the cost cell out of one bench CLI
+    line (everything else — budgets, epochs — is rank-neutral)."""
+    toks = line.split()
+    def val(flag, default=None):
+        return toks[toks.index(flag) + 1] if flag in toks else default
+    cands = [c for c in (val("--candidates", "") or "").split(",") if c]
+    return {"graph": val("--graph", "dcsbm"),
+            "hidden": int(val("--hidden", 256)),
+            "model": val("--model", "graphsage"),
+            "tile_budget_mb": int(val("--tile-budget-mb", 2048)),
+            "candidates": cands or (["gat-anchor"] if val("--model")
+                                    == "gat" else ["ell"])}
+
+
+def levers(name):
+    return [t for t in name.split("+") if t]
+
+
+def cell_features(name, graph, hidden, tile_budget_mb):
+    """StepFeatures for one candidate on the bench workload (single-chip
+    window: wire_mb 0 — wire levers rank through uncertainty, their byte
+    win needs a pod)."""
+    toks = levers(name)
+    base = toks[0]
+    tile = 256 if "t256" in toks else 512
+    quant_g = any(t in ("i8g", "f8g") for t in toks)
+    row_bytes = hidden * (1 if quant_g else 2)
+    cov = (COVERAGE_RO if "ro" in toks else COVERAGE)[graph]
+    if base == "ell":
+        slots, tiles = EDGES / FILL, 0.0
+    else:
+        if graph == "dcsbm" and tile == 256 and "ro" not in toks:
+            slots, cov = 16.78e6, 0.797       # measured t256 estimate
+        else:
+            slots = EDGES * (1.0 - cov) / FILL
+        tiles = TILES_AT_DCSBM * (cov / COVERAGE["dcsbm"]) \
+            * (4.0 if tile == 256 else 1.0)
+        # bigger tile budget buys marginal extra coverage
+        tiles *= tile_budget_mb / 2048.0 if tile_budget_mb > 2048 else 1.0
+    # dense slab work scales with hidden width (tile_us is calibrated
+    # at H=256)
+    tiles *= hidden / 256.0
+    return pmod.StepFeatures(
+        n_apps=N_APPS, gather_slots=slots, row_bytes=row_bytes,
+        gather_path="unroll" if "i8g" in toks else "materialize",
+        dense_tiles=int(tiles), tile=tile,
+        dense_path=("none" if base == "ell"
+                    else "pallas" if "pallas" in toks else "xla"),
+        wire_mb=0.0)
+
+
+def score_line(line, table):
+    info = parse_line(line)
+    best = None
+    for name in info["candidates"]:
+        novel = [t for t in levers(name) if t not in MEASURED_LEVERS]
+        unc = UNCERTAINTY_BASE + UNCERTAINTY_PER_NOVEL * len(novel)
+        if info["model"] == "gat" or name == "gat-anchor":
+            # no SpMM cell: attention path, model does not cover it
+            cell = {"name": "gat-anchor", "pred_s": None, "speedup": 1.0,
+                    "uncertainty": 0.35, "gain": 0.35, "novel": ["gat"]}
+        else:
+            feat = cell_features(name, info["graph"], info["hidden"],
+                                 info["tile_budget_mb"])
+            pred = pmod.predict_step_s(feat, table)
+            speedup = BEST_MEASURED_S / max(pred, 1e-9)
+            cell = {"name": name, "pred_s": pred, "speedup": speedup,
+                    "uncertainty": unc, "gain": unc * speedup,
+                    "novel": novel}
+        if best is None or cell["gain"] > best["gain"]:
+            best = cell
+    return {"line": line, "graph": info["graph"], **best}
+
+
+def rank(lines, table):
+    scored = [score_line(ln, table) for ln in lines]
+    # stable: ties keep the curated order
+    return sorted(scored, key=lambda s: -s["gain"])
+
+
+def render(scored):
+    out = ["| # | candidate (best of line) | graph | pred s/epoch | "
+           "speedup vs 0.5715 | unc | info gain |",
+           "|---|---|---|---|---|---|---|"]
+    for i, s in enumerate(scored, 1):
+        pred = "n/a" if s["pred_s"] is None else f"{s['pred_s']:.3f}"
+        spd = f"{s['speedup']:.2f}x"
+        out.append(f"| {i} | `{s['name']}` | {s['graph']} | {pred} | "
+                   f"{spd} | {s['uncertainty']:.2f} | {s['gain']:.2f} |")
+    return "\n".join(out)
+
+
+def pod_projection(table):
+    """papers100M (111M nodes / 1.615B edges) on a 64-chip pod, the
+    round-4 recipe (hybrid+pallas+i8g, SAGE 3x256, METIS-ish partition:
+    ~30% boundary rows, BNS rate 0.5, bf16 wire)."""
+    chips, n_nodes, n_edges = 64, 111.06e6, 1.615e9
+    epc = n_edges / chips
+    cov = COVERAGE["dcsbm"]                     # clustered-graph coverage
+    slots = epc * (1.0 - cov) / FILL
+    tiles = TILES_AT_DCSBM * (epc * cov) / (EDGES * COVERAGE["dcsbm"])
+    boundary = 0.30 * n_nodes / chips
+    wire_mb = boundary * 0.5 * 256 * 2 / 1e6    # rows x rate x H x bf16
+    n_exchanges = 2 * (3 - 1)                   # 3 layers, fwd+bwd
+    feat = pmod.StepFeatures(
+        n_apps=N_APPS, gather_slots=slots, row_bytes=256,
+        gather_path="unroll", dense_tiles=int(tiles), tile=512,
+        dense_path="pallas", wire_mb=wire_mb * n_exchanges)
+    parts = pmod.predict_parts(feat, table)
+    return {"chips": chips, "edges_per_chip_M": round(epc / 1e6, 1),
+            "residual_slots_M": round(slots / 1e6, 1),
+            "dense_tiles": int(tiles),
+            "wire_mb_per_exchange": round(wire_mb, 1),
+            "gather_s": round(parts["gather_s"], 4),
+            "dense_s": round(parts["dense_s"], 4),
+            "wire_s": round(parts["wire_s"], 4),
+            "epoch_s": round(parts["step_s"], 4),
+            "chip_s_per_epoch": round(parts["step_s"] * chips, 2)}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="rank .watch_queue by predicted information gain")
+    ap.add_argument("--queue", default=QUEUE)
+    ap.add_argument("--calibration", default="",
+                    help="calibration json (default: bundled)")
+    ap.add_argument("--backend", default="tpu-v5e",
+                    help="calibration table to rank for (the queue is "
+                         "a TPU-window queue, so default v5e)")
+    ap.add_argument("--apply", action="store_true",
+                    help="rewrite the queue file in ranked order "
+                         "(same line set)")
+    ap.add_argument("--pod", action="store_true",
+                    help="print the papers100M 64-chip projection")
+    args = ap.parse_args(argv)
+
+    calib = pcal.load_calibration(args.calibration or None, root=REPO)
+    table = pcal.backend_table(calib, args.backend)
+
+    if args.pod:
+        proj = pod_projection(table)
+        print("papers100M on a 64-chip pod (hybrid+pallas+i8g, SAGE "
+              "3x256, rate 0.5, bf16 wire, ~30% boundary):")
+        for k, v in proj.items():
+            print(f"  {k}: {v}")
+        if not args.apply:
+            return 0
+
+    with open(args.queue) as f:
+        lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+    scored = rank(lines, table)
+    print(render(scored))
+    if args.apply:
+        tmp = args.queue + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(s["line"] for s in scored) + "\n")
+        os.replace(tmp, args.queue)
+        print(f"\nrewrote {args.queue} ({len(scored)} lines, ranked)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
