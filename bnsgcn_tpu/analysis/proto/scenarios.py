@@ -392,6 +392,89 @@ class RetirementLag(Scenario):
         return {"bcasts": outs, "decision": d}
 
 
+class PromotionHandshake(Scenario):
+    """The continual train->deploy promotion cycle (continual.py publishes
+    a refreshed checkpoint, serving adopts it at a drain boundary): rank 0
+    is the trainer broadcasting promotion offers, ranks 1+ are serving
+    replicas applying the REAL monotonic adoption rule
+    (serve.promotion_admissible — the same function ServeCore.promote
+    consults under its lock). Whatever the schedule — a replica crash
+    mid-handshake, a stale cycle re-offered after a newer one, the same
+    cycle promoted twice by racing trainers — no two live replicas may
+    finish on different adopted cycles, and no replica's adoption history
+    may ever step backwards (split-brain)."""
+
+    name = "promotion-handshake"
+    world = 3
+
+    # which cycles the trainer offers, in order, per fault variant
+    _OFFERS = {"stale-promotion": (2, 1), "double-promote": (1, 1)}
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # replicas put nothing before the final ack, so put #1 IS the
+            # ack — the crash lands after the adoptions (crash-during-
+            # promote: the trainer must not hang on the dead replica)
+            ("crash-r1-before-ack", {"crash": [(1, "put", 1, "before")]}),
+            # an older trainer's blob arrives AFTER a newer cycle adopted
+            ("stale-promotion", {"offers": (2, 1)}),
+            # two trainers raced the same cycle: second offer must bounce
+            ("double-promote", {"offers": (1, 1)}),
+            ("delay-promo0", {"delay": [("b/promo0", 0.1, 1)]}),
+        ]
+
+    def body(self, ctx, rank):
+        from bnsgcn_tpu.serve import promotion_admissible
+        offers = (ctx.fault or {}).get("offers") or (1, 2)
+        c = ctx.coord(rank, self.world)
+        # every rank applies the SAME rule to the broadcast offer stream:
+        # replicas model ServeCore.promote's adoption, rank 0 models the
+        # trainer's continual_state view of the promoted cycle — the
+        # global proto-agreement judge then makes any divergence (one
+        # rank adopting what another rejected) a finding for free
+        adopted, history, rejected = 0, [], []
+        for i, cyc in enumerate(offers):
+            offer = c.broadcast(f"promo{i}",
+                                {"cycle": cyc} if rank == 0 else None)
+            ok, why = promotion_admissible(int(offer["cycle"]), adopted)
+            if ok:
+                adopted = int(offer["cycle"])
+                history.append(adopted)
+            else:
+                rejected.append(why)
+        ok, fails = c.gather_ok("promo_done", True)
+        return {"adopted": adopted, "history": history,
+                "rejected": rejected, "ok": ok}
+
+    def check(self, rec):
+        v = []
+        offers = self._OFFERS.get(rec.fault_name, (1, 2))
+        expected = max(offers)
+        finals = {}
+        for r, val in sorted(_done_values(rec).items()):
+            hist = val.get("history", [])
+            if any(b <= a for a, b in zip(hist, hist[1:])):
+                v.append(Violation(
+                    "proto-split-brain",
+                    f"replica rank {r} adoption history {hist} stepped "
+                    f"backwards — a stale promotion was adopted over a "
+                    f"newer cycle"))
+            if val.get("adopted") != expected:
+                v.append(Violation(
+                    "proto-split-brain",
+                    f"replica rank {r} finished on cycle "
+                    f"{val.get('adopted')} where the newest offer was "
+                    f"{expected}"))
+            finals[r] = val.get("adopted")
+        if len(set(finals.values())) > 1:
+            v.append(Violation(
+                "proto-split-brain",
+                f"live replicas finished on different promoted cycles: "
+                f"{finals} — serving fleet is split-brained"))
+        return v
+
+
 # ----------------------------------------------------------------------------
 # file-transport scenarios (the REAL FileTransport against a throwaway dir)
 # ----------------------------------------------------------------------------
@@ -472,5 +555,5 @@ class FileRelaunch(Scenario):
 ALL_SCENARIOS: tuple[Scenario, ...] = (
     AgreeOk(), AgreePreempt(), AgreeWorstWins(), RollbackAck(),
     RollbackExhausted(), SlowDecide(), BroadcastResume(), CrashVerdict(),
-    RetirementLag(), FileBootStale(), FileRelaunch(),
+    RetirementLag(), PromotionHandshake(), FileBootStale(), FileRelaunch(),
 )

@@ -149,6 +149,58 @@ def restore_into(payload: dict, params_template, opt_template=None,
     return params, opt_state, bn_state
 
 
+# ---------------------------------------------------------------------------
+# promotion blobs (continual training): refreshed params + serving table +
+# lineage metadata, shipped through the same integrity chain as checkpoints
+# so a torn promote can never be adopted — serve rejects and keeps the prior
+# table (the rollback half of the promote/rollback contract).
+# ---------------------------------------------------------------------------
+
+PROMOTION = "promotion.blob"
+
+
+def promotion_path(serve_dir: str) -> str:
+    return os.path.join(serve_dir, PROMOTION)
+
+
+def write_promotion(serve_dir: str, *, params, bn_state=None,
+                    hidden=None, logits=None, lineage: dict) -> str:
+    """Atomically publish a promotion blob into `serve_dir`.
+
+    lineage must carry at least {"cycle": int} — the monotonic counter the
+    adopting server checks so a stale or duplicate promote is rejected at
+    the drain boundary. Typical extra keys: parent checkpoint path,
+    artifact digest, consumed delta count, val accuracy before/after."""
+    if "cycle" not in lineage:
+        raise ValueError("promotion lineage must carry a 'cycle' counter")
+    path = promotion_path(serve_dir)
+    write_blob(path, {
+        "kind": "promotion",
+        "params": _to_host(params),
+        "bn_state": _to_host(bn_state) if bn_state is not None else {},
+        "hidden": np.asarray(hidden) if hidden is not None else np.zeros(0),
+        "logits": np.asarray(logits) if logits is not None else np.zeros(0),
+        "lineage": {k: v for k, v in lineage.items()},
+    })
+    return path
+
+
+def read_promotion(path: str) -> dict[str, Any]:
+    """Read + verify a promotion blob. Raises CheckpointCorrupt when the
+    file fails the integrity chain OR is structurally not a promotion
+    (wrong kind, missing lineage/cycle) — the adopting server treats both
+    identically: reject, keep the prior table."""
+    payload = read_blob(path)
+    if not isinstance(payload, dict) or payload.get("kind") != "promotion":
+        raise CheckpointCorrupt(f"{path}: not a promotion blob")
+    lin = payload.get("lineage")
+    if not isinstance(lin, dict) or "cycle" not in lin:
+        raise CheckpointCorrupt(f"{path}: promotion lineage missing 'cycle'")
+    if "params" not in payload or "logits" not in payload:
+        raise CheckpointCorrupt(f"{path}: promotion blob missing params/table")
+    return payload
+
+
 def periodic_path(cfg, epoch: int) -> str:
     name = cfg.graph_name or cfg.derive_graph_name()
     return os.path.join(cfg.ckpt_path, f"{name}_p{cfg.sampling_rate:.2f}_{epoch}.ckpt")

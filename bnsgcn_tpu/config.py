@@ -286,6 +286,40 @@ class Config:
                                         # with / clients connect to, as
                                         # 'host:port' (default
                                         # 127.0.0.1:{serve_port})
+    # --- continual training on an evolving graph (continual.py +
+    # data/incremental.py; `python -m bnsgcn_tpu.main continual ...`).
+    # All defaults are inert: a run that never passes --warm-start /
+    # --cycle-nonce and never invokes the subcommand is bit-identical. ---
+    cycle_epochs: int = 5               # fine-tune epochs per continual cycle
+    cycles: int = 1                     # continual cycles to run (looped
+                                        # train->promote; 1 = one-shot)
+    continual_source: str = "auto"      # where cycle deltas come from:
+                                        # 'server' (live export_deltas RPC
+                                        # handshake), 'log' (flushed delta-log
+                                        # files in serve_dir), 'auto' = server
+                                        # if reachable else log
+    continual_cut_growth: float = 1.5   # staleness budget: re-partition from
+                                        # scratch when edge-cut grows past
+                                        # baseline*factor (else incremental
+                                        # update at the pinned assignment)
+    continual_imbalance: float = 2.0    # staleness budget: re-partition when
+                                        # max/mean per-part edge load exceeds
+                                        # this factor
+    continual_acc_drop: float = 0.02    # promotion gate: refuse to promote
+                                        # (keep serving the prior weights)
+    #                                     when the fine-tuned val accuracy
+    #                                     drops more than this below the old
+    #                                     weights' accuracy on the SAME
+    #                                     mutated graph
+    warm_start: str = ""                # checkpoint blob to warm-start
+                                        # params/BN state from (optimizer
+                                        # starts fresh; mutually exclusive
+                                        # with --resume)
+    cycle_nonce: int = 0                # continual-cycle fold of the
+                                        # sampling/dropout streams (the
+                                        # retry-nonce pattern, high-bit fold
+                                        # domain); 0 = historical streams,
+                                        # bit-identical
 
     # --- observability (obs.py: unified telemetry bus) ---
     obs: str = "on"                     # 'on' (process-wide metrics registry +
@@ -498,6 +532,31 @@ def create_parser() -> argparse.ArgumentParser:
     both("serve-router", type=str, default="",
          help="router 'host:port' a serve-backend registers with (default "
               "127.0.0.1:{serve-port})")
+    # continual training (continual.py; `continual` subcommand)
+    both("cycle-epochs", type=int, default=5,
+         help="fine-tune epochs per continual cycle")
+    p.add_argument("--cycles", type=int, default=1,
+                   help="continual cycles to run (looped train->promote; "
+                        "1 = one-shot)")
+    both("continual-source", type=str, default="auto",
+         choices=["auto", "server", "log"],
+         help="cycle delta source: live export_deltas RPC ('server'), "
+              "flushed delta-log files ('log'), or 'auto'")
+    both("continual-cut-growth", type=float, default=1.5,
+         help="re-partition from scratch when edge-cut exceeds "
+              "baseline*factor; below it the cycle updates artifacts "
+              "incrementally at the pinned assignment")
+    both("continual-imbalance", type=float, default=2.0,
+         help="re-partition when max/mean per-part edge load exceeds this")
+    both("continual-acc-drop", type=float, default=0.02,
+         help="refuse to promote when fine-tuned val accuracy drops more "
+              "than this below the old weights on the same mutated graph")
+    both("warm-start", type=str, default="",
+         help="checkpoint blob to warm-start params/BN state from (fresh "
+              "optimizer; mutually exclusive with --resume)")
+    both("cycle-nonce", type=int, default=0,
+         help="continual-cycle sampling/dropout stream fold (0 = "
+              "bit-identical historical streams)")
     # observability (obs.py)
     p.add_argument("--obs", type=str, default="on", choices=["on", "off"],
                    help="unified telemetry bus: metrics registry + "

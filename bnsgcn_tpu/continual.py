@@ -1,0 +1,390 @@
+"""Continual training on an evolving graph: the train -> deploy cycle.
+
+One cycle (`python -m bnsgcn_tpu.main continual --serve-dir ... \
+--cycle-epochs N`, loop with --cycles):
+
+  1. CONSUME — pull the serving delta journal past the cycle's consumed
+     cursor: live `export_deltas` handshake against a running server
+     (one lock hold on the server marks the handoff point, so a delta
+     landing mid-export is never double-consumed or dropped), or the
+     flushed delta-log/snapshot files when no server answers. A cursor
+     that predates a compaction fold resyncs from the snapshot blob +
+     tail instead — nothing in history is ever lost to compaction.
+  2. FOLD — update the partition artifacts (data/incremental.py):
+     append edges into the per-part CSR and boundary/halo tables,
+     recompute only touched degree/norm rows, no METIS rerun. The
+     staleness budget (--continual-cut-growth / --continual-imbalance)
+     decides when cumulative drift justifies a from-scratch re-partition
+     instead; either way the decision is an `artifact_update` obs event.
+     Artifacts are versioned per cycle (`<graph_name>-c<N>`) — the prior
+     dir is never mutated, so a crashed cycle re-runs cleanly.
+  3. FINE-TUNE — warm-start run_training from the serving checkpoint on
+     the mutated graph: fresh optimizer, cycle-nonce-refolded BNS/dropout
+     streams, reorder perms migrated for untouched parts only.
+  4. PROMOTE — gate on validation accuracy (the OLD weights evaluated on
+     the SAME mutated graph are the bar: regressions past
+     --continual-acc-drop keep serving the prior weights), then publish a
+     promotion blob through the checkpoint integrity chain and ask the
+     server to adopt it at a drain boundary (serve.ServeCore.promote;
+     offline servers adopt at next startup). The consumed cursor always
+     advances — graph deltas are facts; only the WEIGHTS roll back.
+
+Cycle state (consumed cursor, cycle counter, artifact lineage, staleness
+baseline) lives in `continual_state.json` inside the serve dir, written
+atomically, so the artifacts and meta.json of a non-continual run stay
+byte-identical.
+
+Exit codes: 0 ok (including a no-op cycle with nothing to consume),
+2 config/usable-input error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+from bnsgcn_tpu import checkpoint as ckpt
+from bnsgcn_tpu import obs as obs_mod
+from bnsgcn_tpu.config import Config, ConfigError, parse_config
+from bnsgcn_tpu.data import incremental as inc
+from bnsgcn_tpu.data.artifacts import (build_artifacts, load_artifacts,
+                                       save_artifacts)
+from bnsgcn_tpu.data.partitioner import partition_graph
+from bnsgcn_tpu.run import artifact_digest, artifacts_dir, run_training
+from bnsgcn_tpu.utils.metrics import calc_acc
+
+STATE = "continual_state.json"
+
+
+# ---------------------------------------------------------------------------
+# cycle state (consumed cursor + lineage), atomic like the delta log
+# ---------------------------------------------------------------------------
+
+def state_path(serve_dir: str) -> str:
+    return os.path.join(serve_dir, STATE)
+
+
+def load_state(serve_dir: str) -> dict:
+    path = state_path(serve_dir)
+    if not os.path.exists(path):
+        return {"cycle": 0, "consumed": 0, "artifact_dir": "",
+                "base_artifact_dir": "", "graph_name": "", "baseline": None}
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_state(serve_dir: str, st: dict) -> str:
+    os.makedirs(serve_dir, exist_ok=True)
+    path = state_path(serve_dir)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(st, f, sort_keys=True, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# delta acquisition: live handshake or flushed files
+# ---------------------------------------------------------------------------
+
+def _server_export(cfg: Config, cursor: int, log) -> Optional[dict]:
+    """One export_deltas round trip, or None when no server answers (the
+    'auto' source falls back to the flushed files)."""
+    from bnsgcn_tpu import serve
+    from bnsgcn_tpu.parallel import coord as coord_mod
+    try:
+        resp = serve.request(cfg.serve_port,
+                             {"op": "export_deltas", "cursor": int(cursor)},
+                             addr=cfg.serve_addr or "127.0.0.1",
+                             timeout_s=5.0)
+    except coord_mod.CoordTimeout:
+        return None
+    if not resp.get("ok"):
+        raise ConfigError(f"export_deltas rejected: {resp.get('err')} — "
+                          f"the consumed cursor in {STATE} is ahead of the "
+                          f"server's journal (wrong serve dir?)")
+    return resp
+
+
+def acquire_deltas(cfg: Config, serve_dir: str, consumed: int,
+                   log) -> tuple[list, int, Optional[dict], str]:
+    """(tail entries, new consumed cursor, snapshot mutation_state or None,
+    source used). A non-None snapshot means the entries before it were
+    compacted away: the cycle must resync the mutated graph from the base
+    artifacts + snapshot + tail instead of splicing just the tail."""
+    source = cfg.continual_source
+    resp = None
+    if source in ("server", "auto"):
+        resp = _server_export(cfg, consumed, log)
+        if resp is None and source == "server":
+            raise ConfigError(
+                f"--continual-source server: no serve server answering on "
+                f"port {cfg.serve_port}")
+    if resp is not None:
+        if not resp.get("snapshot_required"):
+            return list(resp["deltas"]), int(resp["total"]), None, "server"
+        # cursor predates the last compaction fold: the snapshot holds the
+        # folded prefix; re-export at the fold point for the live tail
+        snap = ckpt.read_blob(os.path.join(serve_dir, "serve_snapshot.blob"))
+        tail = _server_export(cfg, int(resp["folded"]), log)
+        if tail is None or not tail.get("ok"):
+            raise ConfigError("server vanished mid-export handshake")
+        return list(tail["deltas"]), int(tail["total"]), snap, "server"
+
+    # offline: flushed delta-log (the tail) + optional compaction snapshot
+    log_path = os.path.join(serve_dir, "delta_log.jsonl")
+    snap_path = os.path.join(serve_dir, "serve_snapshot.blob")
+    entries = inc.read_delta_entries(log_path) if os.path.exists(log_path) \
+        else []
+    if os.path.exists(snap_path):
+        snap = ckpt.read_blob(snap_path)
+        folded = int(snap["n_deltas"])
+        if consumed < folded:
+            return entries, folded + len(entries), snap, "log"
+        return entries[consumed - folded:], folded + len(entries), None, "log"
+    return entries[consumed:], len(entries), None, "log"
+
+
+# ---------------------------------------------------------------------------
+# one cycle
+# ---------------------------------------------------------------------------
+
+def _eval_acc(params, state, spec, g, edge_chunk: int) -> float:
+    from bnsgcn_tpu.evaluate import full_graph_logits
+    logits = full_graph_logits(params, state, spec, g, edge_chunk)
+    return calc_acc(logits[g.val_mask], np.asarray(g.label)[g.val_mask])
+
+
+def _restore_templates(cfg: Config, payload: dict, g):
+    """(params, state, spec) with the checkpoint's weights restored into
+    fresh templates sized for graph g."""
+    import jax
+
+    from bnsgcn_tpu.models.gnn import init_params, spec_from_config
+    cfg = cfg.replace(n_feat=g.n_feat, n_class=g.n_class, n_train=g.n_train)
+    spec = spec_from_config(cfg)
+    # template init only — every leaf is overwritten by restore_into, so
+    # the key's value is irrelevant; seed-derived keeps stream hygiene
+    params, state = init_params(jax.random.key(int(cfg.seed)), spec)
+    p, _, s = ckpt.restore_into(payload, jax.device_get(params), None,
+                                jax.device_get(state))
+    return p, s, spec
+
+
+def run_cycle(cfg: Config, log=print,
+              obs: Optional[obs_mod.Obs] = None) -> dict:
+    """One consume -> fold -> fine-tune -> promote cycle. Returns a summary
+    dict ({"noop": True} when there was nothing to consume)."""
+    serve_dir = cfg.serve_dir or os.path.join(cfg.ckpt_path, "serve")
+    st = load_state(serve_dir)
+    cycle = int(st["cycle"]) + 1
+    consumed = int(st["consumed"])
+    base_name = st.get("graph_name") or cfg.graph_name \
+        or cfg.derive_graph_name()
+    cfg = cfg.replace(graph_name=base_name)
+    cur_dir = st.get("artifact_dir") or artifacts_dir(cfg)
+    base_dir = st.get("base_artifact_dir") or artifacts_dir(cfg)
+
+    entries, new_consumed, snap, source = acquire_deltas(
+        cfg, serve_dir, consumed, log)
+    if not entries and snap is None:
+        log(f"[continual] cycle {cycle}: nothing to consume past cursor "
+            f"{consumed} ({source}) — no-op")
+        if obs is not None:
+            obs.emit("continual_cycle", cycle=cycle, noop=True,
+                     consumed=consumed, source=source)
+        return {"ok": True, "noop": True, "cycle": cycle}
+
+    t0 = time.perf_counter()
+    art = load_artifacts(cur_dir)
+    n_parts = art.n_parts
+    baseline = st.get("baseline") or inc.artifact_stats(art)
+
+    # ---- fold the tail into the artifacts ----
+    repartition_why = None
+    touched_edges: list = []
+    info: dict = {}
+    if snap is not None:
+        # compaction swallowed part of the un-consumed history: rebuild the
+        # mutated graph from the BASE artifacts + snapshot + tail at the
+        # CURRENT part assignment (still no METIS rerun)
+        base_art = load_artifacts(base_dir)
+        g2 = inc.apply_delta_batch(inc.graph_from_artifacts(base_art),
+                                   inc.batch_from_snapshot(snap))
+        g2 = inc.apply_delta_batch(g2, inc.delta_batch(entries))
+        _, part_of, _ = inc._global_maps(art)
+        new_art = build_artifacts(g2, part_of)
+        touched_edges = list(range(n_parts))
+        info = {"resync": True, "new_edges": int(g2.n_edges - art.src.shape[0])}
+    else:
+        batch = inc.delta_batch(entries)
+        try:
+            new_art, info = inc.update_artifacts(art, batch)
+            touched_edges = list(info["touched_edges"])
+        except inc.IncrementalUnsupported as ex:
+            log(f"[continual] incremental splice unsupported ({ex}); "
+                f"from-scratch build at the pinned assignment")
+            g2 = inc.apply_delta_batch(inc.graph_from_artifacts(art), batch)
+            _, part_of, _ = inc._global_maps(art)
+            new_art = build_artifacts(g2, part_of)
+            touched_edges = list(range(n_parts))
+            info = {"fallback": str(ex), "new_edges": int(len(batch.edges))}
+
+    # ---- staleness budget: incremental vs re-partition ----
+    stats = inc.artifact_stats(new_art)
+    repart, why = inc.staleness_decision(
+        stats, baseline, cfg.continual_cut_growth, cfg.continual_imbalance)
+    if repart:
+        g2 = inc.graph_from_artifacts(new_art)
+        pid = partition_graph(g2, n_parts, method=cfg.partition_method,
+                              obj=cfg.partition_obj, seed=cfg.seed)
+        new_art = build_artifacts(g2, pid)
+        touched_edges = list(range(n_parts))
+        stats = inc.artifact_stats(new_art)
+        baseline = stats            # drift resets against the fresh cut
+        repartition_why = why
+        log(f"[continual] staleness budget crossed ({why}): re-partitioned "
+            f"from scratch (cut {stats['cut']})")
+    digest = artifact_digest(new_art)
+    name = f"{base_name}-c{cycle}"
+    new_dir = os.path.join(cfg.part_path, name)
+    save_artifacts(new_art, new_dir)
+    if not repart and not snap:
+        inc.migrate_reorder_cache(cfg, art, new_art, touched_edges, log=log)
+    if obs is not None:
+        obs.emit("artifact_update", cycle=cycle, dir=new_dir, digest=digest,
+                 repartitioned=bool(repart), reason=repartition_why or "",
+                 cut=int(stats["cut"]), imbalance=float(stats["imbalance"]),
+                 touched=sorted(int(p) for p in touched_edges),
+                 new_edges=int(info.get("new_edges", 0)),
+                 consumed_from=consumed, consumed_to=new_consumed,
+                 elapsed_s=round(time.perf_counter() - t0, 3))
+    log(f"[continual] cycle {cycle}: folded deltas [{consumed}, "
+        f"{new_consumed}) into {new_dir} "
+        f"({'re-partitioned' if repart else 'incremental'}, "
+        f"digest {digest}, {time.perf_counter() - t0:.1f}s)")
+
+    # ---- warm-start fine-tune on the mutated graph ----
+    found = ckpt.serving_checkpoint(cfg, log=log)
+    if found is None:
+        raise ConfigError(
+            f"no usable serving checkpoint under {cfg.ckpt_path} to "
+            f"warm-start from — train once before running continual")
+    warm_path, warm_payload = found
+    g2 = inc.graph_from_artifacts(new_art)
+    before_acc = _eval_acc(*_restore_templates(cfg, warm_payload, g2), g2,
+                           cfg.edge_chunk)
+    cfg2 = cfg.replace(graph_name=name, skip_partition=True, resume=False,
+                       n_epochs=cfg.cycle_epochs, warm_start=warm_path,
+                       cycle_nonce=cycle, inductive=False, eval=True,
+                       # a short fine-tune must still eval (and so
+                       # checkpoint a best model) at least once
+                       log_every=max(1, min(cfg.log_every,
+                                            cfg.cycle_epochs)),
+                       ckpt_path=os.path.join(cfg.ckpt_path,
+                                              f"continual_c{cycle}"))
+    res = run_training(cfg2, g=g2, art=new_art, verbose=False)
+    after_acc = float(res.best_val_acc)
+
+    # ---- promotion gate + publish ----
+    promoted = False
+    if after_acc + cfg.continual_acc_drop < before_acc:
+        log(f"[continual] cycle {cycle}: fine-tuned val acc {after_acc:.4f} "
+            f"regressed past the gate (old weights on the same graph: "
+            f"{before_acc:.4f}, budget {cfg.continual_acc_drop}) — keeping "
+            f"the serving weights (the consumed cursor still advances)")
+        if obs is not None:
+            obs.emit("promote", status="rolled_back", cycle=cycle,
+                     before_acc=round(before_acc, 6),
+                     after_acc=round(after_acc, 6))
+    else:
+        tuned = ckpt.serving_checkpoint(cfg2, log=log)
+        if tuned is None:
+            raise ConfigError(
+                f"fine-tune cycle {cycle} left no usable checkpoint under "
+                f"{cfg2.ckpt_path}")
+        tuned_path, tuned_payload = tuned
+        from bnsgcn_tpu.evaluate import full_graph_embeddings
+        p2, s2, spec2 = _restore_templates(cfg, tuned_payload, g2)
+        hidden, logits = full_graph_embeddings(p2, s2, spec2, g2,
+                                               cfg.edge_chunk)
+        promo = ckpt.write_promotion(
+            serve_dir, params=p2, bn_state=s2, hidden=hidden, logits=logits,
+            lineage={"cycle": cycle, "consumed": int(new_consumed),
+                     "artifact_dir": new_dir, "artifact_digest": digest,
+                     "ckpt": tuned_path,
+                     "before_acc": round(before_acc, 6),
+                     "after_acc": round(after_acc, 6)})
+        promoted = True
+        adopt = None
+        if source == "server":
+            from bnsgcn_tpu import serve
+            adopt = serve.request(cfg.serve_port,
+                                  {"op": "promote", "path": promo},
+                                  addr=cfg.serve_addr or "127.0.0.1",
+                                  timeout_s=60.0)
+            if not adopt.get("ok"):
+                log(f"[continual] server declined the promotion "
+                    f"({adopt.get('err')}); the blob stays published for "
+                    f"startup adoption")
+        log(f"[continual] cycle {cycle}: promoted {promo} (val "
+            f"{before_acc:.4f} -> {after_acc:.4f}"
+            + (f", adopted live, {adopt.get('dirty', 0)} node(s) re-marked"
+               if adopt and adopt.get("ok") else ", adopt-at-startup") + ")")
+
+    if obs is not None:
+        obs.emit("continual_cycle", cycle=cycle, source=source,
+                 consumed_from=consumed, consumed_to=new_consumed,
+                 repartitioned=bool(repart), artifact_dir=new_dir,
+                 digest=digest, before_acc=round(before_acc, 6),
+                 after_acc=round(after_acc, 6), promoted=promoted,
+                 test_acc=round(float(res.test_acc), 6),
+                 epochs=int(cfg.cycle_epochs))
+
+    save_state(serve_dir, {
+        "cycle": cycle, "consumed": int(new_consumed),
+        "artifact_dir": new_dir, "base_artifact_dir": base_dir,
+        "graph_name": base_name, "baseline": {
+            "cut": int(baseline["cut"]),
+            "edges": [int(e) for e in baseline["edges"]],
+            "imbalance": float(baseline["imbalance"])},
+        "last": {"promoted": promoted, "before_acc": before_acc,
+                 "after_acc": after_acc, "digest": digest}})
+    return {"ok": True, "cycle": cycle, "promoted": promoted,
+            "consumed": int(new_consumed), "artifact_dir": new_dir,
+            "before_acc": before_acc, "after_acc": after_acc}
+
+
+def continual_main(argv=None) -> int:
+    """`python -m bnsgcn_tpu.main continual ...` — one-shot (--cycles 1)
+    or looped train->deploy cycles."""
+    cfg = parse_config(argv)
+    if not cfg.graph_name:
+        cfg = cfg.replace(graph_name=cfg.derive_graph_name())
+    log = print
+    obs = obs_mod.make_obs(cfg, rank=0, log=log)
+    rc = 0
+    try:
+        for _ in range(max(int(cfg.cycles), 1)):
+            out = run_cycle(cfg, log=log, obs=obs)
+            if out.get("noop"):
+                break
+    except (ConfigError, ckpt.CheckpointCorrupt, inc.IncrementalError,
+            FileNotFoundError) as ex:
+        print(f"[config] {ex}", file=sys.stderr)
+        rc = 2
+    finally:
+        if obs is not None:
+            obs.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(continual_main())

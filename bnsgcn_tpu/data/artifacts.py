@@ -31,6 +31,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from bnsgcn_tpu.data.graph import Graph
+from bnsgcn_tpu.data.partitioner import degree_norm_row
 
 
 def _pad_to(x: int, mult: int) -> int:
@@ -145,7 +146,8 @@ def build_artifacts(g: Graph, part_id: np.ndarray,
         srcs.append(es)
         dsts.append(ed)
         max_e = max(max_e, len(es))
-        out_deg_ext[p, :n_inner[p]] = out_deg_g[inner[p]]
+        out_deg_ext[p, :pad_inner] = degree_norm_row(out_deg_g, inner[p],
+                                                     pad_inner)
 
     pad_edges = _pad_to(max_e, edge_mult)
     src_a = np.zeros((P, pad_edges), dtype=np.int32)
@@ -175,7 +177,7 @@ def build_artifacts(g: Graph, part_id: np.ndarray,
         vm[p, :k] = g.val_mask[inner[p]]
         sm[p, :k] = g.test_mask[inner[p]]
         im[p, :k] = True
-        ind[p, :k] = in_deg_g[inner[p]]
+        ind[p] = degree_norm_row(in_deg_g, inner[p], pad_inner)
         gnid[p, :k] = inner[p]
 
     from bnsgcn_tpu.ops.ell import compute_geometry
@@ -426,6 +428,8 @@ def load_artifacts(path: str, parts: "list[int] | None" = None) -> PartitionArti
     has len(parts) rows in the given order; n_parts and meta stay global."""
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
+    from bnsgcn_tpu.data.partitioner import validate_artifact_dir
+    validate_artifact_dir(path, meta["n_parts"], parts)
     shared = np.load(os.path.join(path, "shared.npz"))
     part_ids = list(range(meta["n_parts"])) if parts is None else list(parts)
     loaded = [np.load(os.path.join(path, f"part{p}.npz")) for p in part_ids]

@@ -1,0 +1,540 @@
+"""Incremental partition-artifact updates from a serving delta log.
+
+The serving tier (serve.py / serve_backend.py) journals graph mutations as
+line-JSON deltas — ``{"op": "add_edges", ...}`` / ``{"op": "update_feat",
+...}`` — and folds them into snapshot blobs on compaction. This module
+replays that same wire format into the *partitioned training artifacts*
+without a METIS rerun: new edges are appended into the per-part edge lists
+and boundary/halo tables, feature rows are overwritten in place, and only
+touched degree/norm rows are recomputed.
+
+Bitwise contract (pinned by tests/test_continual.py): for a delta batch D
+over base graph G with part assignment ``part_id``,
+
+    update_artifacts(build_artifacts(G, part_id), D)
+        == build_artifacts(apply_delta_batch(G, D), part_id)
+
+array-for-array. Everything downstream (halo strategies, reorder, layouts,
+eval logits) is a deterministic function of the artifact arrays, so logits
+equality across those knobs follows from array equality. The update mirrors
+`build_artifacts`' construction law exactly:
+
+  * delta edges land at the END of the mutated graph's edge arrays, so each
+    part's own-edge segment and each (sender -> receiver) cross segment grow
+    at the tail, in delta order — stored order is preserved for old edges;
+  * boundary lists are np.unique-sorted by global id, so a new boundary
+    node *shifts slots* of everything after it: receivers of a changed
+    pair are re-encoded, everyone else is copied verbatim;
+  * pads (pad_boundary / pad_edges) are recomputed with the same _pad_to
+    law; a pad growth triggers a mechanical remap of all parts (slot
+    arithmetic only — values are untouched);
+  * degree/norm rows are rebuilt through the same pure helper the offline
+    builder uses (partitioner.degree_tables / degree_norm_row), only for
+    parts whose relevant global degrees or slot layout changed.
+
+Only dense-format artifacts are supported (the streaming builder's within-
+part edge order is not segment-grouped); `IncrementalUnsupported` tells the
+caller to fall back to a from-scratch rebuild at the SAME part assignment —
+still no METIS rerun.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from bnsgcn_tpu.data.artifacts import PartitionArtifacts, _pad_to
+from bnsgcn_tpu.data.graph import Graph
+from bnsgcn_tpu.data.partitioner import degree_norm_row, degree_tables
+
+
+class IncrementalError(RuntimeError):
+    """Malformed delta batch or artifact (wrong dtype, out-of-range node)."""
+
+
+class IncrementalUnsupported(IncrementalError):
+    """Artifact layout this updater cannot splice (e.g. streaming-built
+    parts, whose cross edges are not grouped by sender). Callers fall back
+    to a from-scratch build of the mutated graph at the same part_id."""
+
+
+# ---------------------------------------------------------------------------
+# delta wire format (PR 16's journal lines / snapshot mutation_state)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeltaBatch:
+    """Parsed mutation batch in ingestion order.
+
+    edges: [K, 2] int64 (u, v) — appended to the graph in this order.
+    feats: [(node, vec_f32)] — applied in order, later wins.
+    feat_full: optional [N, F] f32 wholesale feature replacement (snapshot
+    resync path); applied after per-node updates.
+    """
+    edges: np.ndarray
+    feats: list = field(default_factory=list)
+    feat_full: "np.ndarray | None" = None
+
+    @property
+    def empty(self) -> bool:
+        return (len(self.edges) == 0 and not self.feats
+                and self.feat_full is None)
+
+
+def delta_batch(entries: "list[dict]") -> DeltaBatch:
+    """Collect journal entries (dicts in the serve wire format) into one
+    batch. Unknown ops raise — a silent skip here would desync the consumed
+    cursor from what actually got folded into the artifacts."""
+    edges: list = []
+    feats: list = []
+    for d in entries:
+        op = d.get("op")
+        if op == "add_edges":
+            for u, v in d["edges"]:
+                edges.append((int(u), int(v)))
+        elif op == "update_feat":
+            feats.append((int(d["node"]),
+                          np.asarray(d["feat"], dtype=np.float32)))
+        else:
+            raise IncrementalError(f"unknown delta op {op!r}")
+    e = (np.asarray(edges, dtype=np.int64).reshape(-1, 2) if edges
+         else np.empty((0, 2), dtype=np.int64))
+    return DeltaBatch(edges=e, feats=feats)
+
+
+def read_delta_entries(path: str) -> "list[dict]":
+    """Journal tail as written by serve.flush_delta_log — one JSON per line."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def batch_from_snapshot(state: dict) -> DeltaBatch:
+    """Full mutation history from a compacted snapshot's mutation_state:
+    appended edges in the state's deterministic (u-sorted, insertion-ordered)
+    eout order, features replaced wholesale."""
+    eout_u = np.asarray(state["eout_u"], dtype=np.int64)
+    eout_v = np.asarray(state["eout_v"], dtype=np.int64)
+    edges = np.stack([eout_u, eout_v], axis=1) if len(eout_u) \
+        else np.empty((0, 2), dtype=np.int64)
+    return DeltaBatch(edges=edges,
+                      feat_full=np.asarray(state["feat"], dtype=np.float32))
+
+
+def apply_delta_batch(g: Graph, batch: DeltaBatch) -> Graph:
+    """The mutated graph a from-scratch build would see: delta edges
+    appended at the END of the edge arrays (preserving base order), feature
+    rows overwritten. No re-canonicalization — deltas only reference
+    existing nodes, so self-loops stay where the base graph put them."""
+    if len(batch.edges):
+        lo = int(batch.edges.min())
+        hi = int(batch.edges.max())
+        if lo < 0 or hi >= g.n_nodes:
+            raise IncrementalError(
+                f"delta edge endpoint {lo if lo < 0 else hi} outside "
+                f"[0, {g.n_nodes})")
+    dt = g.src.dtype
+    src = np.concatenate([g.src, batch.edges[:, 0].astype(dt)])
+    dst = np.concatenate([g.dst, batch.edges[:, 1].astype(dt)])
+    feat = g.feat.copy()
+    for n, vec in batch.feats:
+        feat[n] = vec
+    if batch.feat_full is not None:
+        feat = np.asarray(batch.feat_full, dtype=np.float32).copy()
+    return Graph(g.n_nodes, src, dst, feat, g.label, g.train_mask,
+                 g.val_mask, g.test_mask, g.multilabel)
+
+
+# ---------------------------------------------------------------------------
+# artifact <-> global recovery
+# ---------------------------------------------------------------------------
+
+
+def _global_maps(art: PartitionArtifacts):
+    """(N, part_of[N] i32, loc[N] i64) recovered from global_nid rows."""
+    P = art.n_parts
+    if art.feat.shape[0] != P:
+        raise IncrementalUnsupported(
+            f"partial artifact load ({art.feat.shape[0]} of {P} parts); "
+            f"incremental update needs the full bundle")
+    N = int(art.n_inner.sum())
+    part_of = np.full(N, -1, dtype=np.int32)
+    loc = np.full(N, -1, dtype=np.int64)
+    for p in range(P):
+        k = int(art.n_inner[p])
+        ids = art.global_nid[p, :k]
+        part_of[ids] = p
+        loc[ids] = np.arange(k)
+    if (part_of < 0).any():
+        raise IncrementalError("artifact global_nid does not cover a dense "
+                               "[0, N) node id space")
+    return N, part_of, loc
+
+
+def _global_degrees(art: PartitionArtifacts, N: int):
+    """Global canonical (in_deg, out_deg) f32 recovered from the per-part
+    degree/norm rows — the inverse of what degree_norm_row laid down."""
+    in_g = np.zeros(N, dtype=np.float32)
+    out_g = np.zeros(N, dtype=np.float32)
+    for p in range(art.n_parts):
+        k = int(art.n_inner[p])
+        ids = art.global_nid[p, :k]
+        in_g[ids] = art.in_deg[p, :k]
+        out_g[ids] = art.out_deg_ext[p, :k]
+    return in_g, out_g
+
+
+def _pair_members(art: PartitionArtifacts, p: int, j: int) -> np.ndarray:
+    """B(p->j) as sorted global ids (the np.unique set the builder stored)."""
+    k = int(art.n_b[p, j])
+    return art.global_nid[p, art.bnd[p, j, :k].astype(np.int64)]
+
+
+def _decode_part_edges(art: PartitionArtifacts, p: int):
+    """Part p's real edges in stored order as (u_gl, v_gl, sender) with
+    sender == -1 for own edges. Raises IncrementalUnsupported when the
+    stored order is not [own | sender 0 | sender 1 | ...] grouped (the
+    dense builder's layout the splice below relies on)."""
+    sp = art.src[p].astype(np.int64)
+    dp = art.dst[p].astype(np.int64)
+    real = dp < art.pad_inner
+    sp, dp = sp[real], dp[real]
+    v_gl = art.global_nid[p, dp]
+    own = sp < art.pad_inner
+    u_gl = np.empty(len(sp), dtype=np.int64)
+    u_gl[own] = art.global_nid[p, sp[own]]
+    h = ~own
+    q = (sp[h] - art.pad_inner) // art.pad_boundary
+    k = (sp[h] - art.pad_inner) % art.pad_boundary
+    u_gl[h] = art.global_nid[q, art.bnd[q, p, k].astype(np.int64)]
+    sender = np.full(len(sp), -1, dtype=np.int64)
+    sender[h] = q
+    if len(sender) and (np.diff(sender) < 0).any():
+        raise IncrementalUnsupported(
+            f"part {p} edges are not sender-grouped (streaming-built "
+            f"artifact?); rebuild from scratch at the same part assignment")
+    return u_gl, v_gl, sender
+
+
+def graph_from_artifacts(art: PartitionArtifacts) -> Graph:
+    """Reassemble a host Graph from the artifact bundle (parts ascending,
+    within-part stored edge order). Used by the continual driver so a cycle
+    needs no access to the original dataset files. Edge order differs from
+    the dataset's canonical order — aggregation is order-invariant, and the
+    incremental path never rebuilds artifacts from this graph."""
+    N, part_of, _loc = _global_maps(art)
+    us, vs = [], []
+    for p in range(art.n_parts):
+        u_gl, v_gl, _ = _decode_part_edges(art, p)
+        us.append(u_gl)
+        vs.append(v_gl)
+    src = np.concatenate(us) if us else np.empty(0, np.int64)
+    dst = np.concatenate(vs) if vs else np.empty(0, np.int64)
+    F = art.n_feat
+    feat = np.zeros((N, F), dtype=np.float32)
+    if art.multilabel:
+        label = np.zeros((N, art.label.shape[2]), dtype=np.float32)
+    else:
+        label = np.zeros(N, dtype=np.int64)
+    tm = np.zeros(N, dtype=bool)
+    vm = np.zeros(N, dtype=bool)
+    sm = np.zeros(N, dtype=bool)
+    for p in range(art.n_parts):
+        k = int(art.n_inner[p])
+        ids = art.global_nid[p, :k]
+        feat[ids] = np.asarray(art.feat[p, :k], dtype=np.float32)
+        label[ids] = art.label[p, :k]
+        tm[ids] = art.train_mask[p, :k]
+        vm[ids] = art.val_mask[p, :k]
+        sm[ids] = art.test_mask[p, :k]
+    return Graph(N, src, dst, feat, label, tm, vm, sm, art.multilabel)
+
+
+# ---------------------------------------------------------------------------
+# the incremental update
+# ---------------------------------------------------------------------------
+
+
+def update_artifacts(art: PartitionArtifacts, batch: DeltaBatch,
+                     node_mult: int = 8, boundary_mult: int = 8,
+                     edge_mult: int = 8) -> tuple[PartitionArtifacts, dict]:
+    """Fold a delta batch into the artifact bundle; returns (new_art, info).
+
+    info: {"touched_edges": parts whose src/dst changed (the reorder-perm
+    invalidation set), "touched": all parts with any array change,
+    "new_edges", "new_cross", "feat_updates", pads, per-part edge counts}.
+    """
+    P = art.n_parts
+    pad_inner = art.pad_inner
+    old_pb, old_pe = art.pad_boundary, art.pad_edges
+    if art.feat.dtype != np.float32:
+        raise IncrementalUnsupported(
+            f"feat dtype {art.feat.dtype} (streaming bfloat16 artifact?); "
+            f"incremental update supports dense float32 bundles only")
+    N, part_of, loc = _global_maps(art)
+    in_g, out_g = _global_degrees(art, N)
+
+    edges = np.asarray(batch.edges, dtype=np.int64).reshape(-1, 2)
+    if len(edges):
+        if edges.min() < 0 or edges.max() >= N:
+            raise IncrementalError(
+                f"delta edge endpoint outside [0, {N})")
+    du, dv = edges[:, 0], edges[:, 1]
+    d_in, d_out = degree_tables(du, dv, N)
+    in_new = in_g + d_in.astype(np.float32)
+    out_new = out_g + d_out.astype(np.float32)
+    pu, pv = part_of[du], part_of[dv]
+    cross = pu != pv
+
+    # -- new boundary sets; only pairs with new cross endpoints can change
+    bsets: dict = {}                       # (p, j) -> sorted global ids
+    changed_pairs: list = []
+    for key in sorted(set(zip(pu[cross].tolist(), pv[cross].tolist()))):
+        p, j = key
+        old = _pair_members(art, p, j)
+        add = np.unique(du[cross & (pu == p) & (pv == j)])
+        new = np.union1d(old, add)
+        bsets[key] = new
+        if len(new) != len(old):
+            changed_pairs.append(key)
+    n_b_new = art.n_b.copy()
+    for (p, j), s in bsets.items():
+        n_b_new[p, j] = len(s)
+    max_b = int(n_b_new.max()) if P > 1 else 0
+    new_pb = _pad_to(max_b, boundary_mult) if max_b else boundary_mult
+
+    def members(p, j):
+        return bsets.get((p, j), _pair_members(art, p, j))
+
+    # -- touched sets
+    du_u = np.unique(du)
+    dv_u = np.unique(dv)
+    slot_touched = {j for (_p, j) in changed_pairs}
+    edge_touched = set(np.unique(pv).tolist()) | slot_touched
+    deg_out_touched = set(np.unique(part_of[du_u]).tolist()) if len(du_u) \
+        else set()
+    for j in range(P):
+        if j in deg_out_touched or j in slot_touched:
+            continue
+        for q in range(P):
+            if art.n_b[q, j] and len(du_u) \
+                    and np.isin(_pair_members(art, q, j), du_u).any():
+                deg_out_touched.add(j)
+                break
+    deg_in_touched = set(np.unique(part_of[dv_u]).tolist()) if len(dv_u) \
+        else set()
+    feat_nodes = np.asarray(sorted({int(n) for n, _ in batch.feats}),
+                            dtype=np.int64)
+    feat_touched = set(np.unique(part_of[feat_nodes]).tolist()) \
+        if len(feat_nodes) else set()
+    if batch.feat_full is not None:
+        feat_touched = set(range(P))
+    bnd_touched = {p for (p, _j) in changed_pairs}
+
+    # -- per-part real edge counts -> new pad_edges (same _pad_to law)
+    old_counts = (art.dst < pad_inner).sum(axis=1).astype(np.int64)
+    new_counts = old_counts + np.bincount(pv, minlength=P).astype(np.int64) \
+        if len(pv) else old_counts
+    new_pe = _pad_to(int(new_counts.max()), edge_mult)
+
+    # -- bnd / n_b (sender rows); repad everyone on pad_boundary growth
+    if new_pb == old_pb and not bnd_touched:
+        bnd_new = art.bnd
+    else:
+        bnd_new = np.zeros((P, P, new_pb), dtype=np.int32)
+        bnd_new[:, :, :old_pb] = art.bnd
+        for (p, j) in bsets:
+            s = bsets[(p, j)]
+            bnd_new[p, j] = 0
+            bnd_new[p, j, :len(s)] = loc[s]
+
+    # -- src/dst: re-encode touched receivers, remap/repad the rest
+    src_a = np.zeros((P, new_pe), dtype=np.int32)
+    dst_a = np.full((P, new_pe), pad_inner, dtype=np.int32)
+    for p in range(P):
+        if p in edge_touched:
+            u_gl, v_gl, sender = _decode_part_edges(art, p)
+            mine = pv == p
+            nu, nv = du[mine], dv[mine]
+            n_sender = np.where(part_of[nu] == p, -1,
+                                part_of[nu].astype(np.int64))
+            enc_s, enc_d = [], []
+            for c in [-1] + [q for q in range(P) if q != p]:
+                for useg, vseg in ((u_gl[sender == c], v_gl[sender == c]),
+                                   (nu[n_sender == c], nv[n_sender == c])):
+                    if not len(useg):
+                        continue
+                    if c == -1:
+                        enc_s.append(loc[useg])
+                    else:
+                        bs = members(c, p)
+                        pos = np.searchsorted(bs, useg)
+                        enc_s.append(pad_inner + c * new_pb + pos)
+                    enc_d.append(loc[vseg])
+            es = np.concatenate(enc_s) if enc_s else np.empty(0, np.int64)
+            ed = np.concatenate(enc_d) if enc_d else np.empty(0, np.int64)
+            src_a[p, :len(es)] = es
+            dst_a[p, :len(ed)] = ed
+        else:
+            k = int(old_counts[p])
+            sp = art.src[p, :k].astype(np.int64)
+            if new_pb != old_pb:
+                h = sp >= pad_inner
+                q = (sp[h] - pad_inner) // old_pb
+                r = (sp[h] - pad_inner) % old_pb
+                sp[h] = pad_inner + q * new_pb + r
+            src_a[p, :k] = sp
+            dst_a[p, :k] = art.dst[p, :k]
+
+    # -- degree/norm rows through the shared pure helper
+    in_deg = art.in_deg.copy()
+    for p in deg_in_touched:
+        k = int(art.n_inner[p])
+        in_deg[p] = degree_norm_row(in_new, art.global_nid[p, :k], pad_inner)
+    n_ext_new = pad_inner + P * new_pb
+    ext_rebuild = set(range(P)) if new_pb != old_pb \
+        else deg_out_touched | slot_touched
+    if new_pb == old_pb:
+        out_ext = art.out_deg_ext.copy()
+    else:
+        out_ext = np.ones((P, n_ext_new), dtype=np.float32)
+    for p in range(P):
+        if p not in ext_rebuild:
+            continue
+        k = int(art.n_inner[p])
+        row = np.ones(n_ext_new, dtype=np.float32)
+        row[:pad_inner] = degree_norm_row(out_new, art.global_nid[p, :k],
+                                          pad_inner)
+        for q in range(P):
+            nb = int(n_b_new[q, p])
+            if nb:
+                base = pad_inner + q * new_pb
+                row[base:base + nb] = out_new[members(q, p)]
+        out_ext[p] = row
+
+    # -- features
+    feat = art.feat
+    if feat_touched:
+        feat = feat.copy()
+        for n, vec in batch.feats:
+            feat[part_of[n], loc[n]] = np.asarray(vec, dtype=np.float32)
+        if batch.feat_full is not None:
+            for p in range(P):
+                k = int(art.n_inner[p])
+                feat[p, :k] = batch.feat_full[art.global_nid[p, :k]]
+
+    # -- geometry: same deterministic recompute as the offline builder
+    from bnsgcn_tpu.ops.ell import compute_geometry
+    from bnsgcn_tpu.ops.ell_attention import gat_geometry
+    geometry = compute_geometry(src_a, dst_a, pad_inner, n_ext_new)
+    geometry["gat_fwd"] = gat_geometry(src_a, dst_a, pad_inner, n_ext_new)
+
+    new_art = PartitionArtifacts(
+        n_parts=P, pad_inner=pad_inner, pad_boundary=new_pb,
+        pad_edges=new_pe, n_inner=art.n_inner, n_b=n_b_new,
+        feat=feat, label=art.label, train_mask=art.train_mask,
+        val_mask=art.val_mask, test_mask=art.test_mask,
+        inner_mask=art.inner_mask, in_deg=in_deg, out_deg_ext=out_ext,
+        src=src_a, dst=dst_a, bnd=bnd_new, global_nid=art.global_nid,
+        n_feat=art.n_feat, n_class=art.n_class, n_train=art.n_train,
+        multilabel=art.multilabel, ell_geometry=geometry,
+    )
+    touched = (edge_touched | deg_in_touched | deg_out_touched
+               | slot_touched | feat_touched | bnd_touched)
+    info = {
+        "touched_edges": sorted(edge_touched),
+        "touched": sorted(touched),
+        "new_edges": int(len(edges)),
+        "new_cross": int(cross.sum()),
+        "feat_updates": len(batch.feats)
+        + (N if batch.feat_full is not None else 0),
+        "pad_boundary": int(new_pb), "pad_edges": int(new_pe),
+        "edge_counts": new_counts.tolist(),
+    }
+    return new_art, info
+
+
+# ---------------------------------------------------------------------------
+# staleness budget
+# ---------------------------------------------------------------------------
+
+
+def artifact_stats(art: PartitionArtifacts) -> dict:
+    """Partition-quality metrics straight from the artifact arrays: cross
+    (cut) edge count, per-part real edge counts, edge-load imbalance."""
+    real = art.dst < art.pad_inner
+    counts = real.sum(axis=1).astype(np.int64)
+    cut = int((real & (art.src >= art.pad_inner)).sum())
+    mean = float(counts.mean()) if len(counts) else 1.0
+    return {"cut": cut, "edges": counts.tolist(),
+            "imbalance": float(counts.max() / max(mean, 1.0))}
+
+
+def staleness_decision(stats: dict, baseline: dict,
+                       max_cut_growth: float,
+                       max_imbalance: float) -> tuple[bool, dict]:
+    """Re-partition from scratch only when the incremental path has decayed
+    past budget: edge-cut growth vs the last-repartition baseline, or
+    per-part edge-load imbalance. Pure — the obs emit happens at the caller
+    so the decision shows up in the event log either way."""
+    base_cut = max(int(baseline.get("cut", 0)), 1)
+    growth = stats["cut"] / base_cut
+    imb = stats["imbalance"]
+    repartition = bool(growth > max_cut_growth or imb > max_imbalance)
+    return repartition, {
+        "repartition": repartition, "cut": stats["cut"],
+        "baseline_cut": int(baseline.get("cut", 0)),
+        "cut_growth": round(float(growth), 4),
+        "imbalance": round(float(imb), 4),
+        "max_cut_growth": float(max_cut_growth),
+        "max_imbalance": float(max_imbalance),
+    }
+
+
+# ---------------------------------------------------------------------------
+# reorder-perm migration: invalidate only touched parts
+# ---------------------------------------------------------------------------
+
+
+def migrate_reorder_cache(cfg, old_art: PartitionArtifacts,
+                          new_art: PartitionArtifacts,
+                          touched_edges: "list[int]", log=print) -> bool:
+    """Seed the mutated artifact's reorder-perm cache entry from the old
+    one: untouched parts keep their order rows (cluster_reorder is a pure
+    per-part function of (src, dst, pad_inner, n_inner), none of which
+    changed for them — pad growth only moves halo slot ids, which the
+    inner-inner LPA mask never sees), touched parts are recomputed. The
+    result is bitwise what compute_orders would produce from scratch, so
+    the content-addressed cache key stays honest."""
+    from bnsgcn_tpu.data import reorder as ro
+    if not getattr(cfg, "cache_dir", "") or \
+            getattr(cfg, "reorder", "off") in ("off", None, ""):
+        return False
+    import os
+    tile = int(getattr(cfg, "block_tile", 512) or 512)
+    old_path = ro.reorder_cache_path(cfg, old_art, tile)
+    new_path = ro.reorder_cache_path(cfg, new_art, tile)
+    if old_path is None or new_path is None or os.path.exists(new_path):
+        return False
+    from bnsgcn_tpu.utils.diskcache import atomic_dump, try_load
+    orders = try_load(old_path, log)
+    if orders is None or orders.shape != (old_art.feat.shape[0],
+                                          old_art.pad_inner):
+        return False
+    orders = orders.copy()
+    for p in touched_edges:
+        orders[p] = ro.cluster_reorder(
+            new_art.src[p], new_art.dst[p], new_art.pad_inner,
+            int(new_art.n_inner[p]), tile_r=tile)
+    os.makedirs(cfg.cache_dir, exist_ok=True)
+    atomic_dump(orders, new_path)
+    log(f"reorder: migrated perm cache ({len(touched_edges)} of "
+        f"{old_art.n_parts} parts recomputed)")
+    return True

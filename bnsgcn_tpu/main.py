@@ -46,6 +46,13 @@ def main(argv=None):
         # (part, replica) owning that shard's table/CSR/delta state
         from bnsgcn_tpu import serve_backend
         return serve_backend.backend_main(argv[1:])
+    if argv and argv[0] == "continual":
+        # continual training on an evolving graph: consume the serving
+        # delta journal, fold it into the partition artifacts
+        # incrementally, warm-start a fine-tune, promote the refreshed
+        # checkpoint back to serving (exit 2 on config errors, like serve)
+        from bnsgcn_tpu import continual
+        sys.exit(continual.continual_main(argv[1:]))
     cfg = parse_config(argv)
     if not cfg.fix_seed:
         # reference randomizes the seed unless --fix-seed (main.py:13-16)

@@ -65,6 +65,9 @@ EVENT_KINDS = (
     # serving (serve.py; serve_router.py / serve_backend.py for the
     # partition-sharded fleet)
     "serve_header", "serve_drain", "delta", "serve_fleet", "serve_compact",
+    # continual training on an evolving graph (continual.py ingestion/
+    # promotion cycle; serve.py emits 'promote' at the adoption boundary)
+    "continual_cycle", "artifact_update", "promote",
     # benchmarking (bench.py)
     "bench_header", "bench_variant", "bench_end",
     # strict-execution guard (strict.py, --strict-exec)

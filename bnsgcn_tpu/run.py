@@ -52,7 +52,8 @@ from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc
 from bnsgcn_tpu.trainer import (LAST_BUILD_TIMINGS, build_block_arrays,
                                 build_step_fns, init_training,
                                 local_part_ids, param_global_norm, place_blocks,
-                                place_blocks_local, place_replicated)
+                                place_blocks_local, place_replicated,
+                                warm_start_state)
 from bnsgcn_tpu.utils import traceparse
 from bnsgcn_tpu.utils.timers import EpochTimer, estimate_static_hbm, format_memory_stats
 
@@ -60,6 +61,20 @@ from bnsgcn_tpu.utils.timers import EpochTimer, estimate_static_hbm, format_memo
 def artifacts_dir(cfg: Config) -> str:
     name = cfg.graph_name or cfg.derive_graph_name()
     return os.path.join(cfg.part_path, name)
+
+
+def artifact_digest(art) -> str:
+    """Content address of the partition: sha1 over (n_b, src, dst), the
+    same recipe the layout and reorder caches key by. The continual cycle
+    records it in promotion lineage / run_header so a promoted model is
+    traceable to the exact mutated artifact it was fine-tuned on."""
+    import hashlib
+    dg = hashlib.sha1()
+    for a in (art.n_b, art.src, art.dst):
+        # buffer protocol, not .tobytes(): no transient copy of the
+        # (papers100M-scale: multi-GB) edge arrays just to hash them
+        dg.update(np.ascontiguousarray(a))
+    return dg.hexdigest()[:12]
 
 
 def prepare_partition(cfg: Config, g: Optional[Graph] = None,
@@ -321,8 +336,6 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     # uses, so knob changes can never read a stale geometry.
     layout_cache = lc_loaded = None
     if cfg.cache_dir:
-        import hashlib
-
         from bnsgcn_tpu.trainer import (ell_layout_key, gat_layout_key,
                                         hybrid_layout_key)
         from bnsgcn_tpu.utils.diskcache import (atomic_dump, sweep_stale_tmp,
@@ -336,12 +349,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         # pure function of (src, dst) — a re-partition under the same graph
         # name (changed seed, random method) or another host's partial-load
         # rows must never read each other's files
-        dg = hashlib.sha1()
-        for a in (art.n_b, art.src, art.dst):
-            # buffer protocol, not .tobytes(): no transient copy of the
-            # (papers100M-scale: multi-GB) edge arrays just to hash them
-            dg.update(np.ascontiguousarray(a))
-        digest = dg.hexdigest()[:12]
+        digest = artifact_digest(art)
 
         def _lc_path(key):
             return os.path.join(
@@ -478,8 +486,15 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             f"{steady_wire_mb:.2f} MB "
             f"({steady_wire_mb / max(halo_wire_mb, 1e-12):.0%} of peak)")
     if obs is not None:
+        # continual-cycle provenance: only attached when a cycle is live, so
+        # every pre-continual run_header stays byte-identical
+        continual_hdr = ({"warm_start": cfg.warm_start,
+                          "cycle_nonce": int(cfg.cycle_nonce),
+                          "artifact_digest": artifact_digest(art)}
+                         if (cfg.warm_start or cfg.cycle_nonce) else None)
         obs.emit(
             "run_header", mesh=mesh_desc(mesh),
+            **({"continual": continual_hdr} if continual_hdr else {}),
             replicas=int(fns.n_replicas), parts=int(cfg.n_partitions),
             feat=int(fns.n_feat), halo=halo_label, wire=hspec.wire,
             wire_mb_per_exchange=round(halo_wire_mb, 4),
@@ -805,11 +820,36 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             elif best_acc > 0:
                 best_acc = 0.0      # no matching best params: restart tracking
 
+    if cfg.warm_start:
+        # continual-cycle fine-tune entry: params + BN state come from the
+        # serving checkpoint, the optimizer stays fresh, the epoch counter
+        # starts at 0 — a different contract from --resume (which continues
+        # one run's own history), so combining them is a named config error
+        # rather than a silent winner
+        if cfg.resume:
+            raise ConfigError(
+                "--warm-start and --resume are mutually exclusive: resume "
+                "continues a run's own optimizer/epoch history, warm start "
+                "re-initializes both from another run's weights")
+        p, s = warm_start_state(cfg, params, state, log=log)
+        params = place_p(p)
+        state = place_replicated(s, mesh)
+
     # Both keys derive from cfg.seed: every process of a multi-host run MUST
     # agree on the sampling key or the shared-PRNG BNS exchange desyncs
     # (main.py broadcasts the randomized seed from process 0).
     base_sample_key = jax.random.key(seed)
     base_drop_key = jax.random.key(seed + 1)
+    if cfg.cycle_nonce:
+        # continual-cycle refold (the retry-nonce pattern one level up):
+        # each fine-tune cycle draws fresh BNS/dropout streams instead of
+        # replaying cycle 0's schedule on a mutated graph. The high-bit
+        # offset keeps the cycle fold domain disjoint from the small
+        # positive divergence-retry folds applied on top (fold_in data is
+        # uint32); nonce 0 is bit-identical.
+        cyc = (1 << 31) | (int(cfg.cycle_nonce) & 0x7FFFFFFF)
+        base_sample_key = jax.random.fold_in(base_sample_key, cyc)
+        base_drop_key = jax.random.fold_in(base_drop_key, cyc)
 
     def _fold_keys(nonce: int):
         """Retry-nonce fold of the sampling/dropout streams: after the n-th

@@ -101,6 +101,18 @@ def load_table(path: str) -> tuple[np.ndarray, np.ndarray, dict]:
             dict(payload.get("meta") or {}))
 
 
+def promotion_admissible(cycle: int, adopted: int):
+    """The monotonic adoption rule for continual-training promotions:
+    (ok, reason). A cycle at or below the last adopted one is stale —
+    adopting it would replay an older trainer's weights over newer ones
+    (the split-brain the graftcheck-proto promotion-handshake scenario
+    explores). One rule, shared by every adoption site, so the model
+    checker and the server cannot drift apart."""
+    if int(cycle) <= int(adopted):
+        return False, f"stale cycle {int(cycle)} <= adopted {int(adopted)}"
+    return True, ""
+
+
 # ----------------------------------------------------------------------------
 # the serving graph: base CSR + appended deltas
 # ----------------------------------------------------------------------------
@@ -502,6 +514,13 @@ class ServeCore:
         self._snapshot_name = SNAPSHOT
         self._folded = 0            # guarded-by: self._lock
         self._compacting = False    # guarded-by: self._lock
+        # continual-training promotion: last adopted lineage cycle — the
+        # monotonic check that rejects stale/double promotes (split-brain
+        # guard: two backends can never end up on different cycles because
+        # a replayed older promote is refused, not re-adopted)
+        self._promoted_cycle = 0    # guarded-by: self._lock
+        self.stats["exported_to"] = 0
+        self.stats["promotions"] = 0
         self.batcher = _TierBBatcher(self._score_batch, cfg.serve_max_batch)
 
     def _check_table(self, hidden: np.ndarray, logits: np.ndarray):
@@ -861,6 +880,147 @@ class ServeCore:
         return {"folded": folded,
                 "replayed": self.replay_delta_log(serve_dir)}
 
+    # -- continual training: delta export handshake + promotion adoption --
+
+    def export_deltas(self, cursor: int = 0) -> dict:
+        """Atomically hand the journal tail past `cursor` (an absolute delta
+        count the continual trainer owns) to a continual cycle, and mark the
+        handoff point. One lock hold snapshots (folded, tail) together, so a
+        delta landing mid-export gets an absolute position >= the returned
+        `total` and is picked up by the next cycle — never double-consumed,
+        never dropped. When compaction already folded deltas past `cursor`
+        the individual entries are gone; `snapshot_required` tells the
+        trainer to resync from the snapshot blob + tail instead."""
+        cursor = int(cursor)
+        with self._lock:
+            folded = self._folded
+            total = folded + len(self.deltas)
+            if cursor > total:
+                return {"ok": False,
+                        "err": f"export cursor {cursor} ahead of journal "
+                               f"total {total}"}
+            if cursor < folded:
+                out = {"ok": True, "snapshot_required": True,
+                       "folded": folded, "total": total, "from": cursor,
+                       "deltas": []}
+            else:
+                out = {"ok": True, "snapshot_required": False,
+                       "folded": folded, "total": total, "from": cursor,
+                       "deltas": [dict(d) for d in
+                                  self.deltas[cursor - folded:]]}
+            self.stats["exported_to"] = total
+        if self.obs is not None:
+            self.obs.emit("delta", op="export", start=cursor, total=total,
+                          handed=len(out["deltas"]),
+                          snapshot_required=bool(out["snapshot_required"]))
+        return out
+
+    def _adopt_table_locked(self, hidden: np.ndarray, logits: np.ndarray):
+        """Swap in a promoted full-graph table (the partition backend
+        overrides this to slice its own shard rows)."""
+        self._check_table(hidden, logits)
+        self.hidden = hidden
+        self.logits = logits
+
+    def _tail_redirty_locked(self, tail: list) -> set:
+        """Dirty set owed to journal entries the promoted table has not
+        seen: the forward closure of their touched nodes. The partition
+        backend overrides this (its journal speaks the fan-out op set and
+        its graph walks closures shard-locally)."""
+        touched: set = set()
+        for d in tail:
+            if d.get("op") == "add_edges":
+                for u, v in d["edges"]:
+                    touched.add(int(u))
+                    touched.add(int(v))
+            elif d.get("op") == "update_feat":
+                touched.add(int(d["node"]))
+        return (self.graph.forward_closure(touched, self.hops)
+                if touched else set())
+
+    def promote(self, path: str) -> dict:
+        """Adopt a refreshed promotion blob (checkpoint.write_promotion) at
+        a drain boundary: the swap happens under one core-lock hold, atomic
+        with respect to every concurrent predict/delta. Rollback semantics:
+        a blob that fails the integrity chain, carries a stale (non-
+        monotonic) cycle, or mismatches the table shape is rejected and the
+        prior params/table stay live.
+
+        Consistency after adoption: the promoted table is the full-graph
+        eval of the mutated graph at the trainer's consumed-delta cursor.
+        Nodes outside the forward closure of the deltas past that cursor
+        have identical L-hop neighborhoods in both graphs, so their rows
+        are exact; everything inside the closure is re-marked dirty (and
+        in-flight refresh claims are re-dirtied so a stale old-params
+        result can never land in the new table)."""
+        def _reject(reason: str, rolled_back: bool = True) -> dict:
+            self.log(f"[serve] promotion rejected ({reason}); "
+                     f"keeping prior table")
+            if self.obs is not None:
+                self.obs.emit("promote", status="rejected", reason=reason,
+                              path=path)
+            return {"ok": False, "err": f"promotion rejected: {reason}",
+                    "rolled_back": rolled_back}
+
+        try:
+            payload = ckpt.read_promotion(path)
+        except (ckpt.CheckpointCorrupt, OSError) as ex:
+            return _reject(str(ex))
+        from flax import serialization
+        lin = payload["lineage"]
+        cycle = int(lin["cycle"])
+        consumed = int(lin.get("consumed", 0))
+        hidden = np.array(payload["hidden"], copy=True)
+        logits = np.array(payload["logits"], copy=True)
+        with self._lock:
+            ok, stale = promotion_admissible(cycle, self._promoted_cycle)
+        if not ok:
+            return _reject(stale, rolled_back=False)
+        try:
+            params = serialization.from_state_dict(self.params,
+                                                   payload["params"])
+            state = (serialization.from_state_dict(self.state,
+                                                   payload["bn_state"])
+                     if payload.get("bn_state") else self.state)
+        except (KeyError, ValueError, TypeError) as ex:
+            return _reject(f"params do not restore into the serving model "
+                           f"({type(ex).__name__}: {ex})")
+        with self._lock:
+            # re-check under the final lock: raced another promote
+            ok, stale = promotion_admissible(cycle, self._promoted_cycle)
+            stale = None if ok else stale
+            if stale is None:
+                try:
+                    self._adopt_table_locked(hidden, logits)
+                except ConfigError as ex:
+                    stale = str(ex)
+            if stale is None:
+                self.params = params
+                self.state = state
+                self._promoted_cycle = cycle
+                tail = self.deltas[max(consumed - self._folded, 0):]
+                redirty = self._tail_redirty_locked(tail)
+                redirty |= set(self._refreshing)
+                self.dirty = set(redirty)
+                self._dirty_since = {n: t for n, t
+                                     in self._dirty_since.items()
+                                     if n in redirty}
+                self._mark_dirty_stamps_locked(redirty)
+                self.stats["promotions"] += 1
+                n_dirty = len(self.dirty)
+                n_tail = len(tail)
+        if stale is not None:
+            return _reject(stale, rolled_back=False)
+        self.log(f"[serve] promoted cycle {cycle}: refreshed table adopted "
+                 f"({n_tail} unconsumed delta(s) re-marked, {n_dirty} "
+                 f"node(s) dirty)")
+        if self.obs is not None:
+            self.obs.emit("promote", status="adopted", cycle=cycle,
+                          consumed=consumed, tail=n_tail, dirty=n_dirty,
+                          path=path)
+        return {"ok": True, "cycle": cycle, "tail": n_tail,
+                "dirty": n_dirty}
+
     def snapshot_stats(self) -> dict:
         with self._lock:
             out = dict(self.stats)
@@ -956,6 +1116,15 @@ class ServeServer:
             out = self.core.update_feat(req["node"], req["feat"])
             self.core.maybe_compact()
             return out
+        if op == "export_deltas":
+            out = self.core.export_deltas(req.get("cursor", 0))
+            if out.get("ok") and self.core.serve_dir:
+                # mirror the handoff point on disk: a trainer reading the
+                # journal file after a crash sees exactly the exported tail
+                self.core.flush_delta_log(self.core.serve_dir)
+            return out
+        if op == "promote":
+            return self.core.promote(req["path"])
         if op == "dirty":
             # include in-flight refresh claims: a claimed node is still
             # stale in the table (same accounting as snapshot_stats) —
@@ -1096,6 +1265,15 @@ def serve_main(argv=None) -> int:
         log(f"[serve] resumed: {counts['folded']} delta(s) from the "
             f"snapshot + {replayed} replayed from the tail log "
             f"({len(core.dirty)} nodes dirty, refreshing in background)")
+    # adopt a promotion published while no server was running (the offline
+    # continual flow: trainer writes the blob, the next serve start picks it
+    # up through the same monotonic/rollback checks as the live op)
+    promo = ckpt.promotion_path(serve_dir)
+    if os.path.exists(promo):
+        adopted = core.promote(promo)
+        if adopted.get("ok"):
+            log(f"[serve] adopted promotion cycle {adopted['cycle']} "
+                f"at startup")
 
     signals = resilience.PreemptSignals(
         action="drain in-flight requests and flush the delta log",

@@ -433,6 +433,28 @@ class BackendCore(serve.ServeCore):
                           part=self.part)
         return {"ok": True}
 
+    def _mark_walk_locked(self, pairs: list) -> tuple:
+        """The dirty-mark BFS over owned out-edges: (reached owned nodes,
+        {remote node: best remaining hop budget}). Caller holds the lock."""
+        best: dict[int, int] = {}
+        remote: dict[int, int] = {}
+        stack = list(pairs)
+        reached: set[int] = set()
+        while stack:
+            v, h = stack.pop()
+            if best.get(v, -1) >= h:
+                continue
+            best[v] = h
+            if not self.graph.owns(v):
+                if remote.get(v, -1) < h:
+                    remote[v] = h
+                continue
+            reached.add(v)
+            if h > 0:
+                for w in self.graph.out_nbrs(v):
+                    stack.append((w, h - 1))
+        return reached, remote
+
     def mark_nodes(self, seeds: list) -> dict:
         """One shard's slice of the router's distributed dirty-mark BFS:
         walk owned out-edges with the remaining hop budget, mark every
@@ -440,24 +462,8 @@ class BackendCore(serve.ServeCore):
         owned elsewhere back as the frontier. Journaled, so a relaunch
         replays its own dirty marks without any cross-part traffic."""
         pairs = [(int(v), int(h)) for v, h in seeds]
-        remote: dict[int, int] = {}
         with self._lock:
-            best: dict[int, int] = {}
-            stack = list(pairs)
-            reached: set[int] = set()
-            while stack:
-                v, h = stack.pop()
-                if best.get(v, -1) >= h:
-                    continue
-                best[v] = h
-                if not self.graph.owns(v):
-                    if remote.get(v, -1) < h:
-                        remote[v] = h
-                    continue
-                reached.add(v)
-                if h > 0:
-                    for w in self.graph.out_nbrs(v):
-                        stack.append((w, h - 1))
+            reached, remote = self._mark_walk_locked(pairs)
             added = reached - self.dirty
             self.dirty |= reached
             self._mark_dirty_stamps_locked(reached)
@@ -481,6 +487,50 @@ class BackendCore(serve.ServeCore):
         with self._lock:
             return {"ok": True, "part": self.part,
                     "rows": self.graph.export_rows(nodes)}
+
+    # -- promotion adoption (continual training cycle) --
+
+    def _adopt_table_locked(self, hidden: np.ndarray, logits: np.ndarray):
+        """A promotion blob carries the FULL-graph table (the continual
+        trainer evaluates the whole mutated graph); keep this shard's rows.
+        A table already shard-sized passes straight through to the check."""
+        hidden = np.asarray(hidden)
+        logits = np.asarray(logits)
+        if (hidden.shape[0] == self.graph.n_nodes
+                and self.graph.n_nodes != self.graph.n_own):
+            hidden = np.array(hidden[self.graph.own_ids], copy=True)
+            logits = np.array(logits[self.graph.own_ids], copy=True)
+        super()._adopt_table_locked(hidden, logits)
+
+    def _tail_redirty_locked(self, tail: list) -> set:
+        """Backend journals speak the fan-out op set; re-seed the dirty
+        mark from the tail the promoted table has not folded. apply_delta/
+        apply_feat entries get the full hop budget (a superset of what the
+        router's original mark reached through this shard — extra dirty
+        only costs a tier-B recompute, never a stale answer); 'mark'
+        entries keep their recorded per-seed budgets. Remote frontier is
+        dropped: those nodes' marks live in their owners' journals."""
+        seeds: dict[int, int] = {}
+
+        def _seed(v: int, h: int):
+            if seeds.get(v, -1) < h:
+                seeds[v] = h
+
+        for d in tail:
+            op = d.get("op")
+            if op == "apply_delta":
+                for u, v in d["edges"]:
+                    _seed(int(u), self.hops)
+                    _seed(int(v), self.hops)
+            elif op == "apply_feat":
+                _seed(int(d["node"]), self.hops)
+            elif op == "mark":
+                for v, h in d["nodes"]:
+                    _seed(int(v), int(h))
+        if not seeds:
+            return set()
+        reached, _ = self._mark_walk_locked(sorted(seeds.items()))
+        return reached
 
     def _apply_logged(self, d: dict):
         if d["op"] == "apply_delta":

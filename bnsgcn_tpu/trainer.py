@@ -1222,6 +1222,24 @@ def init_training(cfg: Config, spec: ModelSpec, mesh: Mesh, seed: int = 0,
     return params, state, opt_state
 
 
+def warm_start_state(cfg: Config, params, state, log=print):
+    """Continual-cycle warm start: adopt params + BN state from the
+    checkpoint blob at cfg.warm_start, keeping the freshly-initialized
+    optimizer (the fine-tune starts its own Adam moments — stale moments
+    from a different graph/epoch horizon are noise, not signal). Returns
+    HOST trees restored into the given templates; the caller re-places
+    them on the mesh exactly like a resume would."""
+    from bnsgcn_tpu import checkpoint as ckpt
+    payload, err = ckpt.load_or_error(cfg.warm_start)
+    if payload is None:
+        raise ConfigError(f"--warm-start checkpoint unusable: {err}")
+    p, _, s = ckpt.restore_into(payload, jax.device_get(params), None,
+                                jax.device_get(state))
+    log(f"Warm start from {cfg.warm_start} (epoch "
+        f"{int(payload.get('epoch', 0))}, fresh optimizer)")
+    return p, s
+
+
 def abstract_step_inputs(cfg: Config, spec: ModelSpec, art, fns: StepFns,
                          tables: dict) -> dict:
     """ShapeDtypeStruct pytrees matching every argument of the compiled

@@ -27,6 +27,9 @@ Sections (each rendered only when the log carries its events):
     the log carries sharded-serving events (`serve_drain` records tagged
     with a backend id, plus the router's `serve_fleet` drain record; the
     backends' `.rN` sibling logs merge in via the same auto-discovery)
+  * continual training — per-cycle before/after accuracy, fold mode
+    (incremental vs repartition), promote/rollback outcome; --compare adds
+    cycle-aligned accuracy deltas between two continual runs
   * bench — per-variant epoch times from a bench.py --obs-log
 
 --compare prints an epoch-aligned loss/step diff plus the header deltas —
@@ -58,10 +61,16 @@ LIFECYCLE_KINDS = ("inject", "rollback", "preempt", "watchdog_fire",
 # audit that gated a pod run sits in the same log as the run it gated
 AUDIT_KINDS = ("ir_audit", "proto_audit", "perf_audit")
 
+# continual training on an evolving graph (continual.py): per-cycle
+# ingestion/fine-tune records plus the serving side's adoption events
+CONTINUAL_KINDS = ("continual_cycle", "artifact_update", "promote")
+
 # the report's sub-vocabularies must stay inside the bus registry —
 # graftlint checks the emit sites, this checks the reader
-assert set(LIFECYCLE_KINDS) | set(AUDIT_KINDS) <= set(EVENT_KINDS), \
-    sorted((set(LIFECYCLE_KINDS) | set(AUDIT_KINDS)) - set(EVENT_KINDS))
+assert (set(LIFECYCLE_KINDS) | set(AUDIT_KINDS) | set(CONTINUAL_KINDS)
+        <= set(EVENT_KINDS)), \
+    sorted((set(LIFECYCLE_KINDS) | set(AUDIT_KINDS) | set(CONTINUAL_KINDS))
+           - set(EVENT_KINDS))
 
 
 def load_run(paths: list[str]) -> list[dict]:
@@ -91,7 +100,7 @@ def summarize(events: list[dict]) -> dict:
                  "epoch_ranks": [], "serve": None, "serve_header": None,
                  "serve_drains": [], "serve_fleet": None,
                  "run_end": None, "traces": [], "bench": [], "audits": [],
-                 "unknown_kinds": {}}
+                 "continual": [], "unknown_kinds": {}}
     for ev in events:
         k = ev.get("kind")
         if k is not None and k not in EVENT_KINDS:
@@ -108,6 +117,8 @@ def summarize(events: list[dict]) -> dict:
             out["lifecycle"].append(ev)
         elif k in AUDIT_KINDS:
             out["audits"].append(ev)
+        elif k in CONTINUAL_KINDS:
+            out["continual"].append(ev)
         elif k == "epoch_ranks":
             out["epoch_ranks"].append(ev)
         elif k == "serve_drain":
@@ -402,6 +413,42 @@ def render(s: dict, write=print):
                   f"{_num(ev.get('refresh_lag_p99_s')):9.3f}  "
                   f"{ev.get('queue_depth', '-'):>5}  "
                   f"{ev.get('halo_hits', 0)}/{ev.get('halo_fetches', 0)}")
+    if s.get("continual"):
+        cycles = [ev for ev in s["continual"]
+                  if ev["kind"] == "continual_cycle"]
+        updates = {int(_num(ev.get("cycle"))): ev for ev in s["continual"]
+                   if ev["kind"] == "artifact_update"}
+        promotes = [ev for ev in s["continual"] if ev["kind"] == "promote"]
+        write("")
+        write("continual training:")
+        if any(not ev.get("noop") for ev in cycles):
+            write("  cycle  deltas       fold            before    after  "
+                  "   d_acc    outcome")
+        for ev in sorted(cycles, key=lambda e: _num(e.get("cycle"))):
+            c = int(_num(ev.get("cycle")))
+            if ev.get("noop"):
+                write(f"  {c:5d}  no-op (cursor {ev.get('consumed')}, "
+                      f"source {ev.get('source', '?')})")
+                continue
+            upd = updates.get(c, {})
+            fold = "repartition" if ev.get("repartitioned") else "incremental"
+            if not ev.get("repartitioned") and "touched" in upd:
+                fold += f"({len(upd['touched'])}p)"
+            ba, aa = _num(ev.get("before_acc")), _num(ev.get("after_acc"))
+            span = (f"[{ev.get('consumed_from')},"
+                    f"{ev.get('consumed_to')})")
+            write(f"  {c:5d}  {span:<11}  {fold:<14}  {ba:<8.4f}  "
+                  f"{aa:<8.4f} {aa - ba:+8.4f}   "
+                  + ("promoted" if ev.get("promoted") else "rolled_back"))
+        # serving-side adoption events (a serve log replaying promotions
+        # shows these without any continual_cycle records alongside)
+        for ev in promotes:
+            st = ev.get("status", "?")
+            if st == "adopted":
+                write(f"  promote adopted: cycle {ev.get('cycle')} "
+                      f"(tail {ev.get('tail')} -> {ev.get('dirty')} dirty)")
+            else:
+                write(f"  promote {st}: {ev.get('reason', '?')}")
     if s["bench"]:
         write("")
         write("bench variants:")
@@ -502,6 +549,36 @@ def compare(sa: dict, sb: dict, name_a: str, name_b: str, write=print):
             ratio = (f"{eb / ea:.3f}" if ea and eb else "-")
             write(f"  {name:<32} {ea if ea is not None else '-':>9}   "
                   f"{eb if eb is not None else '-':>9}   {ratio}")
+    # continual-cycle accuracy trajectories: aligned per cycle index, the
+    # within-cycle fine-tune gain for each run plus the A-vs-B gap after
+    # each promotion decision
+    ca = {int(_num(ev.get("cycle"))): ev for ev in sa.get("continual", [])
+          if ev.get("kind") == "continual_cycle" and not ev.get("noop")}
+    cb = {int(_num(ev.get("cycle"))): ev for ev in sb.get("continual", [])
+          if ev.get("kind") == "continual_cycle" and not ev.get("noop")}
+    if ca or cb:
+        write("")
+        write("  cycle   after_A   gain_A    after_B   gain_B    "
+              "dafter(B-A)")
+        for c in sorted(set(ca) | set(cb)):
+            a, b = ca.get(c), cb.get(c)
+
+            def _cell(ev):
+                if ev is None:
+                    return "-", "-"
+                aa = _num(ev.get("after_acc"))
+                ga = aa - _num(ev.get("before_acc"))
+                mark = "" if ev.get("promoted") else "*"
+                return f"{aa:.4f}{mark}", f"{ga:+.4f}"
+            av, ag = _cell(a)
+            bv, bg = _cell(b)
+            d = (f"{_num(b.get('after_acc')) - _num(a.get('after_acc')):+9.4f}"
+                 if a is not None and b is not None else "        -")
+            write(f"  {c:5d}   {av:<8}  {ag:<8}  {bv:<8}  {bg:<8}  {d}")
+        if any(not ev.get("promoted") for ev in
+               list(ca.values()) + list(cb.values())):
+            write("  (* = cycle rolled back: fine-tune failed the "
+                  "validation gate, serving kept prior weights)")
     ea = {e: list(r.values())[0] for e, r in sa["epochs"].items()}
     eb = {e: list(r.values())[0] for e, r in sb["epochs"].items()}
     shared = sorted(set(ea) & set(eb))

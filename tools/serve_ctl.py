@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""One-shot control client for a running serve server or backend.
+
+The continual-training cycle (bnsgcn_tpu/continual.py) drives the same
+ops programmatically; this is the operator's hand tool — inspect stats,
+pull the delta-log handshake, trigger a promotion, flush, or shut a
+server down, one JSON answer on stdout per call:
+
+  python tools/serve_ctl.py --port 8471 stats
+  python tools/serve_ctl.py --port 8471 export-deltas --cursor 1200
+  python tools/serve_ctl.py --port 8471 promote --blob /path/promotion.blob
+  python tools/serve_ctl.py --port 8471 ping | flush | dirty | shutdown
+
+`export-deltas` prints the server's handshake verbatim: `from`/`total`
+are the cursor interval handed over, `snapshot_required` means the
+cursor predates the last compaction fold and the cycle must resync from
+the snapshot instead (nothing was dropped — the snapshot holds the
+folded prefix). Exit codes: 0 ok, 1 the server answered with an error,
+2 bad usage / unreachable server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bnsgcn_tpu import serve                        # noqa: E402
+from bnsgcn_tpu.parallel import coord as coord_mod  # noqa: E402
+
+OPS = ("ping", "stats", "metrics", "dirty", "flush", "export-deltas",
+       "promote", "shutdown")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("op", choices=OPS)
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--addr", default="127.0.0.1")
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--cursor", type=int, default=0,
+                   help="export-deltas: first journal index not yet "
+                        "consumed (the cycle's handoff cursor)")
+    p.add_argument("--blob", default="",
+                   help="promote: path to the promotion blob the server "
+                        "should adopt")
+    args = p.parse_args(argv)
+
+    payload: dict = {"op": args.op.replace("-", "_")}
+    if args.op == "export-deltas":
+        payload["cursor"] = args.cursor
+    elif args.op == "promote":
+        if not args.blob:
+            p.error("promote requires --blob")
+        payload["path"] = os.path.abspath(args.blob)
+
+    try:
+        resp = serve.request(args.port, payload, addr=args.addr,
+                             timeout_s=args.timeout)
+    except coord_mod.CoordTimeout as ex:
+        print(f"[serve-ctl] {ex}", file=sys.stderr)
+        return 2
+    print(json.dumps(resp, sort_keys=True))
+    return 0 if resp.get("ok", True) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
