@@ -71,12 +71,14 @@ def audit_config(g, variant: Variant):
                   n_class=g.n_class)
 
 
-def trace_variant(variant: Variant, g, art, full_set: bool = False) -> dict:
+def trace_variant(variant: Variant, g, art, full_set: bool = False,
+                  slot_map=None) -> dict:
     """Trace one variant cell. Returns {program name -> TracedProgram}
     plus '_oracle' entries the wire contract compares against. With
     `full_set`, also traces the lever-independent eval/forward/precompute
     programs (done for one cell only — they do not vary with the halo
-    levers)."""
+    levers). `slot_map` threads an elastic part -> slot hosting map into
+    the HaloSpec (the slot-invariance audit re-traces under it)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import AbstractMesh
@@ -90,7 +92,8 @@ def trace_variant(variant: Variant, g, art, full_set: bool = False) -> dict:
     spec = ModelSpec(cfg.model, (g.n_feat, AUDIT_HIDDEN, g.n_class),
                      norm="layer", dropout=0.0, train_size=g.n_train)
     mesh = AbstractMesh((("parts", AUDIT_PARTS),))
-    fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh)
+    fns, hspec, tables, tables_full = build_step_fns(cfg, spec, art, mesh,
+                                                     slot_map=slot_map)
     inp = abstract_step_inputs(cfg, spec, art, fns, tables)
     p, s, o = inp["params"], inp["state"], inp["opt_state"]
     e, blk, tb, key = inp["epoch"], inp["blk"], inp["tables"], inp["key"]
@@ -208,6 +211,43 @@ def run_ir_audit(root: str | None = None, tune_schedule: str | None = None,
                 message=f"variant failed to trace: "
                         f"{type(ex).__name__}: {ex}"))
 
+    # ---- elastic slot-map invariance (run.py --elastic on): a RESIZE
+    # re-hosts whole parts onto fewer workers via mesh.plan_slots, but the
+    # traced step program keeps the full P-wide 'parts' axis regardless —
+    # HaloSpec.slot_map is host-side metadata only. Re-trace the baseline
+    # cell under the part -> slot maps of two world sizes and prove (a)
+    # the collective schedule is IDENTICAL to the unmapped program and
+    # (b) the mapped program is itself rank-symmetric — together: every
+    # survivor of a resize compiles the same schedule it always ran. ----
+    slot_rows: list = []
+    if variants:
+        from bnsgcn_tpu.parallel.mesh import plan_slots
+        try:
+            base_v = variants[0]
+            base = trace_variant(base_v, g, art)
+            for world in (2, AUDIT_PARTS):
+                if progress is not None:
+                    progress(f"[ir] slot map W={world} {base_v.key}")
+                sm = plan_slots(AUDIT_PARTS, world)
+                mapped = trace_variant(base_v, g, art, slot_map=sm)
+                where = f"ir://{base_v.key}#slot-w{world}"
+                sf = C.check_schedule_match(
+                    mapped["train_step"], base["train_step"], where,
+                    what=f"slot-map W={world} retrace")
+                sf += C.check_rank_symmetry(mapped["train_step"], where)
+                findings += sf
+                slot_rows.append({
+                    "world": world, "slot_map": list(sm),
+                    "findings": len(sf),
+                    "collectives": len(mapped["train_step"].collectives)})
+        except Exception as ex:
+            from bnsgcn_tpu.analysis.core import Finding
+            errors.append(f"slot-map: {type(ex).__name__}: {ex}")
+            findings.append(Finding(
+                file="ir://slot-map", line=0, col=0, rule="ir-trace-error",
+                message=f"slot-map retrace failed: "
+                        f"{type(ex).__name__}: {ex}"))
+
     counts: dict = {}
     for f in findings:
         counts[f.rule] = counts.get(f.rule, 0) + 1
@@ -219,6 +259,7 @@ def run_ir_audit(root: str | None = None, tune_schedule: str | None = None,
         "variants_dropped": dropped,
         "elapsed_s": round(time.time() - t0, 2),
         "ok": not findings,
+        "slot_worlds": slot_rows,
         "variants": rows,
         "findings": [f.as_dict() for f in findings],
         "counts": counts,

@@ -79,15 +79,33 @@ class RunContext:
         c._sleep = self.sched.sleep
         return c
 
+    def elastic_coord(self, rank: int, world: int) -> Coordinator:
+        """An elastic-mode coordinator whose dead-peer probe reads the
+        scheduler's ground truth (actors that actually crashed) through
+        the `_peer_dead` seam — the production probe compares alive-beat
+        ages against the wall clock, which virtual time makes
+        meaningless. `dead_after_s` only sets the probe cadence here, so
+        it shrinks under the per-exchange bound."""
+        c = self.coord(rank, world)
+        c.enable_elastic(1)
+        c.dead_after_s = self.timeout_s / 2
+        actors = self.sched.actors
+        c._peer_dead = lambda ranks: [
+            r for r in ranks
+            if any(a.rank == r and a.state == "crashed" for a in actors)]
+        return c
+
     def rm(self, coord: Coordinator, resil_retries: int = 2,
-           have_ckpt: bool = True):
+           have_ckpt: bool = True, elastic: bool = False):
         """A real ResilienceManager wired to the virtual clock: signals
         and watchdog are constructed but never installed/started, and the
         checkpoint seams return deterministic fake payloads — the decide/
         reduce/ack logic under test is the production code."""
         from bnsgcn_tpu.resilience import ResilienceManager
         cfg = SimpleNamespace(inject="", resil_retries=resil_retries,
-                              ckpt_path=self.ckpt_dir)
+                              ckpt_path=self.ckpt_dir,
+                              elastic="on" if elastic else "off",
+                              n_partitions=4)
         m = ResilienceManager(cfg, log=_silent, coord=coord, obs=None)
         m.backoff_base = 0.1
         m._sleep = self.sched.sleep
@@ -476,6 +494,248 @@ class PromotionHandshake(Scenario):
 
 
 # ----------------------------------------------------------------------------
+# elastic world-size scenarios (RESIZE verdicts and the rejoin handshake)
+# ----------------------------------------------------------------------------
+
+class ResizeDuringRollback(Scenario):
+    """A rollback is in flight when the diverged rank dies: the verdict
+    must escalate to RESIZE ('lost' outranks 'diverged' — the restore
+    heals the divergence AND the member set matches reality), and the
+    survivor trains through the loss with NO exit code at all. A death
+    inside the rollback ack window likewise resolves at the next agree
+    boundary instead of stranding the ack."""
+
+    name = "resize-during-rollback"
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # rank 1 puts: #1 heartbeat, #2 verdict, #3 the confirm ack,
+            # #4 the rollback-restore ack
+            ("crash-r1-before-verdict", {"crash": [(1, "put", 2, "before")]}),
+            ("crash-r1-after-verdict", {"crash": [(1, "put", 2, "after")]}),
+            ("crash-r1-before-ack", {"crash": [(1, "put", 4, "before")]}),
+            # a merely-SLOW verdict must roll back normally, never resize
+            ("delay-verdict", {"delay": [("v/", 0.1, 1)]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.elastic_coord(rank, self.world)
+        m = ctx.rm(c, elastic=True)
+        out = {"rollbacks": 0, "resizes": 0}
+        d = m.agree_step(1, "diverged" if rank == 1 else "ok")
+        for _ in range(3):
+            if d["decision"] == "abort":
+                m.raise_abort(d)
+            if d["decision"] == "rollback":
+                out["rollbacks"] += 1
+                m.coord_restore(d, "p", "o", "s")
+            elif d["decision"] == "resize":
+                out["resizes"] += 1
+                c.apply_resize(d)
+                m.coord_restore(d, "p", "o", "s", ack_name="resize")
+                out["members"] = list(c.members)
+                out["restart"] = d["restart"]
+            else:
+                break
+            d = m.agree_step(2, "ok")
+        c.finish()
+        if rank == 0:
+            c.close()
+        return out
+
+    def check(self, rec):
+        v = []
+        vals = _done_values(rec)
+        if (rec.fault_name or "").startswith("crash-r1"):
+            if 0 not in vals:
+                return [Violation(
+                    "proto-exit-code",
+                    f"rank 0 ended {rec.outcomes.get(0, ('?',))[:2]} — a "
+                    f"covered rank loss must RESIZE and train on, never "
+                    f"exit")]
+            out = vals[0]
+            if out.get("resizes", 0) < 1 or out.get("members") != [0]:
+                v.append(Violation(
+                    "proto-agreement",
+                    f"rank 0 never adopted the shrink-to-[0] resize after "
+                    f"rank 1 died: {out}"))
+            elif out.get("restart") != 6:
+                v.append(Violation(
+                    "proto-agreement",
+                    f"resize restart epoch {out.get('restart')} instead "
+                    f"of 6 (checkpoint epoch 5 + 1)"))
+        if rec.fault_name == "delay-verdict":
+            for r, out in sorted(vals.items()):
+                if out.get("resizes"):
+                    v.append(Violation(
+                        "proto-agreement",
+                        f"rank {r} resized under a merely-delayed verdict "
+                        f"— a slow peer is not a dead peer"))
+        return v
+
+
+class CrashDuringResize(Scenario):
+    """A three-rank world where one rank's death triggers a shrink, and a
+    SURVIVOR then crashes inside the resize protocol itself: before its
+    restore ack (the loss defers to the next boundary — a second shrink,
+    never a stranded ack), or rank 0 before publishing the verdict (the
+    peers' bounded fetch turns the dead server into a documented 77)."""
+
+    name = "crash-during-resize"
+    world = 3
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # rank 2's put #1 is its first heartbeat: it dies before ever
+            # contributing a verdict — the canonical shrink trigger
+            ("shrink", {"crash": [(2, "put", 1, "before")]}),
+            # rank 1 survives the shrink verdict but dies before its
+            # resize-restore ack (puts: #1 hb, #2 verdict, #3 confirm,
+            # #4 the resize ack)
+            ("crash-survivor-before-ack",
+             {"crash": [(2, "put", 1, "before"), (1, "put", 4, "before")]}),
+            # rank 0 dies before publishing the resize decision (its puts:
+            # #1 hb, #2 verdict, #3 the decision) — server goes down
+            ("crash-r0-mid-resize",
+             {"crash": [(2, "put", 1, "before"), (0, "put", 3, "before")]}),
+        ]
+
+    def body(self, ctx, rank):
+        c = ctx.elastic_coord(rank, self.world)
+        m = ctx.rm(c, elastic=True)
+        out = {"resizes": 0}
+        d = m.agree_step(1, "ok")
+        for _ in range(3):
+            if d["decision"] == "abort":
+                m.raise_abort(d)
+            if d["decision"] != "resize":
+                break
+            out["resizes"] += 1
+            c.apply_resize(d)
+            m.coord_restore(d, "p", "o", "s", ack_name="resize")
+            out["members"] = list(c.members)
+            d = m.agree_step(2, "ok")
+        c.finish()
+        if rank == 0:
+            c.close()
+        return out
+
+    def check(self, rec):
+        want = {"shrink": ([0, 1], [0, 1]),
+                "crash-survivor-before-ack": ([0], [0])}.get(rec.fault_name)
+        if want is None:
+            return []
+        done_ranks, members = want
+        v = []
+        vals = _done_values(rec)
+        for r in done_ranks:
+            out = vals.get(r)
+            if out is None:
+                v.append(Violation(
+                    "proto-exit-code",
+                    f"rank {r} ended {rec.outcomes.get(r, ('?',))[:2]} — "
+                    f"a covered loss must RESIZE and continue, never exit"))
+            elif out.get("members") != members:
+                v.append(Violation(
+                    "proto-agreement",
+                    f"rank {r} finished with members {out.get('members')} "
+                    f"instead of {members}: {out}"))
+        return v
+
+
+class RejoinStaleToken(Scenario):
+    """A replacement's rejoin races a stale grant: rj/ack/1 still holds
+    the grant minted for an earlier, dead incarnation (different token,
+    bogus seq position). The joiner must skip it — only a grant echoing
+    its OWN fresh token counts — and keep waiting for rank 0's real
+    answer; adopting the stale seq would desync every subsequent
+    collective (both sides then time out a healthy run)."""
+
+    name = "rejoin-stale-token"
+
+    STALE = {"token": "dead-beef", "decision": "resize",
+             "trigger": "rejoin", "members": [0, 1], "seq": 99,
+             "agree_calls": 99, "restart": 0, "source": "<initial state>",
+             "lost": [], "joined": [1], "slots": [0, 0, 1, 1],
+             "retry_nonce": 0, "nonce": 0, "backoff_s": 0.0,
+             "old_world": 1, "world": 2, "epoch": 0}
+
+    def setup(self, ctx):
+        # planted directly in the store (visible from t=0): the previous
+        # incarnation's grant was never consumed before that joiner died
+        ctx.net.store["rj/ack/1"] = (json.dumps(self.STALE), 0.0, 0.0)
+
+    def body(self, ctx, rank):
+        c = ctx.elastic_coord(rank, self.world)
+        if rank == 0:
+            # the incumbent already shrank 2 -> 1 at an earlier boundary:
+            # adopt that state directly — apply_resize would wipe the
+            # planted stale grant, which must survive into the race
+            c.members, c.world = (0,), 1
+            c._lost = {1}
+            m = ctx.rm(c, elastic=True)
+            out = {}
+            d = {"decision": "ok"}
+            for e in range(1, 10):
+                ctx.sched.sleep(0.01)   # the inter-boundary training step
+                d = m.agree_step(e, "ok")
+                if d["decision"] == "resize":
+                    break
+            if d["decision"] == "resize":
+                c.apply_resize(d)
+                m.coord_restore(d, "p", "o", "s", ack_name="resize")
+                out = {"members": list(c.members),
+                       "restart": int(d["restart"]), "seq": c._seq}
+            c.finish()
+            c.close()
+            return out
+        # rank 1: the replacement incarnation, minting a FRESH token; its
+        # first collective is the grow-restore ack at the granted seq
+        grant = c.request_rejoin("fresh-incarnation")
+        c.adopt_grant(grant)
+        c.gather_ok("resize", True)
+        c.finish()
+        return {"members": list(c.members),
+                "restart": int(grant["restart"]), "seq": c._seq}
+
+    def check(self, rec):
+        v = []
+        if rec.fault_name == "delay-grant":
+            for r in (0, 1):
+                o = rec.outcomes.get(r, ("missing",))
+                if o[0] != "done":
+                    v.append(Violation(
+                        "proto-exit-code",
+                        f"rank {r} ended {o[:2]} under a merely-delayed "
+                        f"grant — the joiner must wait out the stale "
+                        f"grant, not die"))
+        if rec.fault_name == "crash-joiner-before-ack":
+            o = rec.outcomes.get(0, ("missing",))
+            if o[0] != "done":
+                v.append(Violation(
+                    "proto-exit-code",
+                    f"rank 0 ended {o[:2]} after the joiner died "
+                    f"mid-admission — the grow ack must impute the loss, "
+                    f"not strand the incumbent"))
+        return v
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # the fresh grant's put is delayed past the joiner's next poll:
+            # it must keep waiting (the overwritten key reads as absent),
+            # never fall back to the stale value it already skipped
+            ("delay-grant", {"delay": [("rj/ack/", 0.05, 1)]}),
+            # the joiner dies after adopting the grant but before its ack
+            # (puts: #1 rj/req, #2 the resize ack): rank 0 imputes the
+            # loss and completes — never hangs on a ghost admission
+            ("crash-joiner-before-ack", {"crash": [(1, "put", 2, "before")]}),
+        ]
+
+
+# ----------------------------------------------------------------------------
 # file-transport scenarios (the REAL FileTransport against a throwaway dir)
 # ----------------------------------------------------------------------------
 
@@ -555,5 +815,7 @@ class FileRelaunch(Scenario):
 ALL_SCENARIOS: tuple[Scenario, ...] = (
     AgreeOk(), AgreePreempt(), AgreeWorstWins(), RollbackAck(),
     RollbackExhausted(), SlowDecide(), BroadcastResume(), CrashVerdict(),
-    RetirementLag(), PromotionHandshake(), FileBootStale(), FileRelaunch(),
+    RetirementLag(), PromotionHandshake(), ResizeDuringRollback(),
+    CrashDuringResize(), RejoinStaleToken(), FileBootStale(),
+    FileRelaunch(),
 )

@@ -27,10 +27,20 @@ clean-at-HEAD gate goes red the same way.
                           reduction: a divergence masked by a preempt
                           resumes from poisoned state
                           -> proto-reduce-order on agree-worst-wins
+    rejoin-token-unchecked
+                          request_rejoin adopts the FIRST grant in
+                          rj/ack without matching its incarnation
+                          token: a stale grant minted for a dead
+                          predecessor yanks the joiner onto a bogus
+                          seq position and both sides time out a
+                          healthy admission
+                          -> proto-exit-code on rejoin-stale-token
 """
 
 from __future__ import annotations
 
+import json
+import os
 from contextlib import contextmanager
 
 from bnsgcn_tpu.parallel import coord as _coord
@@ -97,12 +107,48 @@ def _reduce_order_flipped():
         pr.update(saved)
 
 
+@contextmanager
+def _rejoin_token_unchecked():
+    orig = Coordinator.request_rejoin
+
+    def eager(self, token, info=None):
+        # the reverted decision: any grant will do — no incarnation-token
+        # match, so a dead predecessor's grant is adopted verbatim
+        self._put(f"rj/req/{self.rank}",
+                  json.dumps({"token": str(token), "info": info or {}}))
+        wait_s = float(os.environ.get("BNSGCN_ELASTIC_JOIN_WAIT_S",
+                                      2 * self.timeout_s))
+        deadline = self._deadline(wait_s)
+        while True:
+            try:
+                v = self.transport.try_get(f"rj/ack/{self.rank}", deadline)
+            except _coord.CoordTimeout:
+                v = None
+            if v is not None:
+                try:
+                    return json.loads(v)
+                except ValueError:
+                    pass
+            if self._clock() >= deadline:
+                raise _coord.CoordTimeout(
+                    f"rank {self.rank}: no rejoin grant within "
+                    f"{wait_s:.1f}s")
+            self._sleep(0.005)
+
+    Coordinator.request_rejoin = eager
+    try:
+        yield
+    finally:
+        Coordinator.request_rejoin = orig
+
+
 SEEDED_BUGS = {
     "confirm-removed": _confirm_removed,
     "ack-window-dropped": _ack_window_dropped,
     "retire-horizon-1": _retire_horizon_1,
     "pin-before-get": _pin_before_get,
     "reduce-order-flipped": _reduce_order_flipped,
+    "rejoin-token-unchecked": _rejoin_token_unchecked,
 }
 
 

@@ -121,6 +121,18 @@ def load_checkpoint(path: str) -> dict[str, Any]:
     return read_blob(path)
 
 
+def resilience_extra(payload: dict) -> dict[str, int]:
+    """The resilience counters a checkpoint's `extra` dict carries, with
+    pre-elastic defaults for old files: {"retry_nonce", "resize_nonce"}.
+    Every resume path (cold --resume, rollback, resize, rejoin) adopts BOTH
+    so the sampling/dropout folds replay identically — a pre-elastic
+    checkpoint loads with resize_nonce 0, the identity fold. The payload is
+    mesh-shape-invariant, so the same file restores at any world size."""
+    extra = payload.get("extra") or {}
+    return {"retry_nonce": int(extra.get("retry_nonce", 0)),
+            "resize_nonce": int(extra.get("resize_nonce", 0))}
+
+
 def load_or_error(path: str) -> tuple[Optional[dict], Optional[str]]:
     """(payload, None) when `path` loads and verifies, else (None, reason)
     — reason is one line (missing / torn / checksum-failed / undecodable).

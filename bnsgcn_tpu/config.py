@@ -207,9 +207,25 @@ class Config:
                                         # threads, no signal handlers)
     inject: str = ""                    # deterministic fault injection:
                                         # 'kind@E<epoch>,...' with kinds
-                                        # nan|sigterm|hang|ckpt-corrupt (env
-                                        # $BNSGCN_FAULT); CI proves every
-                                        # recovery path with it
+                                        # nan|sigterm|hang|ckpt-corrupt|
+                                        # ranklost (env $BNSGCN_FAULT); CI
+                                        # proves every recovery path with it.
+                                        # ranklost requires :r<rank> — losing
+                                        # every rank is not a resize
+    elastic: str = "off"                # 'on': a heartbeat-detected rank
+                                        # loss becomes an agreed RESIZE
+                                        # verdict (survivors re-host the P
+                                        # parts via mesh.plan_slots and keep
+                                        # training; a rejoining replacement
+                                        # grows the world back) instead of
+                                        # CoordTimeout -> exit 77. 'off'
+                                        # (default): the exact pre-elastic
+                                        # protocol, bit-identical, exit-code
+                                        # table unchanged. Requires the
+                                        # coordinator (--coord tcp|file)
+    elastic_min_world: int = 1          # smallest world a RESIZE may shrink
+                                        # to; fewer survivors -> agreed abort
+                                        # (78) instead of overloaded workers
     resil_retries: int = 3              # divergence rollbacks (exponential
                                         # backoff) before aborting with a
                                         # diagnostic report
@@ -483,8 +499,19 @@ def create_parser() -> argparse.ArgumentParser:
     p.add_argument("--inject", type=str,
                    default=os.environ.get("BNSGCN_FAULT", ""),
                    help="deterministic fault injection, e.g. "
-                        "'nan@E12,sigterm@E20,hang@E8,ckpt-corrupt@E10'")
+                        "'nan@E12,sigterm@E20,hang@E8,ckpt-corrupt@E10,"
+                        "ranklost@E6:r1'")
     both("resil-retries", type=int, default=3)
+    p.add_argument("--elastic", type=str, default="off",
+                   choices=["off", "on"],
+                   help="elastic world size: agree a coordinated RESIZE on "
+                        "heartbeat-detected rank loss (survivors re-host all "
+                        "parts and keep training; a rejoin grows back) "
+                        "instead of exiting 77 (off = the exact pre-elastic "
+                        "protocol, bit-identical)")
+    both("elastic-min-world", type=int, default=1,
+         help="smallest world --elastic may shrink to before an agreed "
+              "abort (exit 78)")
     p.add_argument("--coord", type=str, default="auto",
                    choices=["auto", "tcp", "file", "off"],
                    help="multi-host rank-coordination channel for agreed "

@@ -111,6 +111,18 @@ def main(argv=None):
         # relaunch it
         print(f"[config] {ex}", file=sys.stderr)
         sys.exit(2)
+    except resilience.RankLostExit as ex:
+        # --inject ranklost@E<e>:r<rank> fired on THIS rank: the process
+        # vanishes mid-run so the survivors' heartbeat liveness (not a
+        # goodbye message) must detect the loss — exactly what a real
+        # preempted host looks like. Exit 0: the harness asserts the
+        # SURVIVORS' resize, not this rank's demise.
+        print(f"[resilience] injected rank loss at epoch {ex.epoch}: "
+              f"exiting without goodbye (survivors must detect via "
+              f"liveness and RESIZE)")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
     except resilience.PreemptedError as ex:
         print(f"[resilience] {ex}")
         sys.stdout.flush()

@@ -59,9 +59,11 @@ EVENT_KINDS = (
     # training lifecycle (run.py)
     "run_header", "epoch", "epoch_ranks", "eval", "trace", "overlap",
     "halo_refresh", "reorder", "layout_build", "tune_decision", "run_end",
-    # resilience (resilience.py: injections, rollback consensus, exits)
+    # resilience (resilience.py: injections, rollback consensus, exits;
+    # 'resize' = the elastic shrink/grow verdict: old/new world, part->slot
+    # map, trigger, resize nonce)
     "inject", "rollback", "divergence_abort", "coord_decision",
-    "watchdog_fire", "preempt", "profile_request", "profile",
+    "watchdog_fire", "preempt", "resize", "profile_request", "profile",
     # serving (serve.py; serve_router.py / serve_backend.py for the
     # partition-sharded fleet)
     "serve_header", "serve_drain", "delta", "serve_fleet", "serve_compact",

@@ -77,14 +77,19 @@ class CoordAbort(CoordError):
 # of every rank's contribution. 'diverged' outranks 'preempted': a preempt
 # checkpoint written from NaN state would poison the resume, so the rollback
 # happens first and the still-set preempt flag fires at the next boundary.
-STATE_PRIORITY = {"ok": 0, "preempted": 1, "diverged": 2, "abort": 3}
+# 'lost' is never contributed locally — rank 0 imputes it (elastic mode)
+# for a peer whose process is provably gone; it outranks 'diverged' because
+# the RESIZE restores the agreed checkpoint anyway, healing the divergence
+# with the same restore while the member set actually matches the verdict.
+STATE_PRIORITY = {"ok": 0, "preempted": 1, "diverged": 2, "lost": 3,
+                  "abort": 4}
 _DECISION_OF = {"ok": "ok", "preempted": "preempt", "diverged": "rollback",
-                "abort": "abort"}
+                "lost": "resize", "abort": "abort"}
 
 
 def reduce_states(states: dict[int, str]) -> str:
     """Worst local state across ranks -> the agreed decision name."""
-    worst = max(states.values(), key=lambda s: STATE_PRIORITY.get(s, 3))
+    worst = max(states.values(), key=lambda s: STATE_PRIORITY.get(s, 99))
     return _DECISION_OF.get(worst, "abort")
 
 
@@ -558,12 +563,40 @@ class Coordinator:
         self._spent: list[tuple[int, list[str]]] = []   # rank 0: (seq, keys)
                             # of completed exchanges, pruned past the horizon
         self._closed = False
+        # elastic membership: the live rank ids, never renumbered (transport
+        # keys keep the original rank numbers). A RESIZE verdict shrinks or
+        # grows this set; `world` tracks len(members). Non-elastic runs never
+        # change it, so members == range(world) and every loop below is
+        # byte-identical to the historical range() form.
+        self.members: tuple[int, ...] = tuple(range(self.world))
+        self.elastic = False
+        self.min_world = 1
+        # a peer is provably dead once its alive-beat (the watchdog thread's
+        # 2 s cadence, resilience._Watchdog.ALIVE_BEAT_S) is this stale
+        self.dead_after_s = float(os.environ.get("BNSGCN_ELASTIC_DEAD_S",
+                                                 6.0))
+        self._peer_dead = self._liveness_dead   # seam: analysis/proto wires
+                            # scheduler ground truth (the sim runs no
+                            # watchdog thread feeding alive heartbeats)
+        self._lost: set[int] = set()    # rank 0: ranks resized away, still
+                            # owed a rejoin beacon (el/lost/<r>)
+        # agree cadence: exchange verdicts every K step boundaries; local
+        # states latch worst-wins in between. All ranks read the same env
+        # knob and count calls in lockstep, so the boundary schedule is
+        # globally consistent and `_seq` never drifts.
+        self.agree_every = max(1, int(os.environ.get(
+            "BNSGCN_COORD_AGREE_EVERY", "1") or 1))
+        self._agree_calls = 0
+        self._latched = "ok"
 
     # -- plumbing --
 
     def _deadline(self, timeout_s: Optional[float] = None) -> float:
         return self._clock() + (self.timeout_s if timeout_s is None
                                 else timeout_s)
+
+    def _peers(self) -> list[int]:
+        return [r for r in self.members if r != self.rank]
 
     def _get(self, key: str, deadline: float, what: str) -> str:
         """Blocking get with poll backoff; CoordTimeout (after a liveness
@@ -637,7 +670,7 @@ class Coordinator:
         """{rank: {'epoch', 'step_age_s', 'alive_age_s'}} from the server's
         receive clock (file transport: mtimes). Missing entries mean the
         rank never reported."""
-        out: dict[int, dict] = {r: {} for r in range(self.world)}
+        out: dict[int, dict] = {r: {} for r in self.members}
         deadline = self._deadline(min(5.0, self.timeout_s))
         for kind, field in ((self.STEP_KEY, "step_age_s"),
                             (self.ALIVE_KEY, "alive_age_s")):
@@ -680,7 +713,7 @@ class Coordinator:
             stalest = None
         write(f"[coord] peer liveness (world {self.world}, viewed from "
               f"rank {self.rank}):")
-        for r in range(self.world):
+        for r in self.members:
             info = live.get(r, {})
             step = (f"step hb {info['step_age_s']:.1f}s ago "
                     f"(epoch {info.get('epoch', -1)})"
@@ -690,11 +723,74 @@ class Coordinator:
             mark = "   <- stalled" if r == stalest else ""
             write(f"[coord]   rank {r}: {step}, {alive}{mark}")
 
+    def _liveness_dead(self, ranks: list[int]) -> list[int]:
+        """Subset of `ranks` whose process is provably gone: the alive-beat
+        (the watchdog thread's, independent of step progress) is older than
+        `dead_after_s`. A rank with NO alive beat on record is NOT imputed
+        dead — a startup race must time out loudly, never resize."""
+        try:
+            live = self.liveness()
+        except CoordError:
+            return []
+        out = []
+        for r in ranks:
+            age = live.get(r, {}).get("alive_age_s")
+            if age is not None and age > self.dead_after_s:
+                out.append(r)
+        return out
+
+    def _gather_elastic(self, keymap: dict[int, str], deadline: float,
+                        what_fn) -> tuple[dict[int, str], list[int]]:
+        """Interleaved gather with dead-peer imputation (elastic mode):
+        poll every missing key round-robin; a rank whose process is provably
+        gone is imputed 'lost' instead of awaited, so one dead peer costs
+        ~`dead_after_s`, not the whole exchange window. An alive-but-silent
+        rank still hits the standard CoordTimeout — a hung rank remains a
+        77 (on a real pod its own watchdog fires first, converting the hang
+        into the very death this path absorbs)."""
+        vals: dict[int, str] = {}
+        lost: list[int] = []
+        missing = dict(keymap)
+        delay = 0.002
+        check_every = min(1.0, self.dead_after_s / 2)
+        next_check = self._clock() + check_every
+        while missing:
+            for r in sorted(missing):
+                try:
+                    v = self.transport.try_get(missing[r], deadline)
+                except CoordTimeout:
+                    v = None
+                if v is not None:
+                    vals[r] = v
+                    del missing[r]
+            if not missing:
+                break
+            now = self._clock()
+            if now >= next_check:
+                next_check = now + check_every
+                for r in self._peer_dead(sorted(missing)):
+                    self.log(f"[coord] rank {r} is gone (alive-beat older "
+                             f"than {self.dead_after_s:.1f}s) — imputing "
+                             f"'lost' instead of waiting on {what_fn(r)}")
+                    lost.append(r)
+                    del missing[r]
+                continue
+            if self._clock() >= deadline:
+                self.log_liveness()
+                r = sorted(missing)[0]
+                raise CoordTimeout(
+                    f"rank {self.rank}: timed out waiting for {what_fn(r)} "
+                    f"(key {missing[r]!r}; per-exchange bound "
+                    f"{self.timeout_s:.1f}s)")
+            self._sleep(min(delay, max(deadline - self._clock(), 0)))
+            delay = min(delay * 2, 0.05)
+        return vals, lost
+
     # -- collectives (lockstep call order across ranks) --
 
     def agree(self, epoch: int, state: str,
               decide_fn: Optional[Callable[[str, dict], dict]] = None,
-              info: Optional[dict] = None) -> dict:
+              info: Optional[dict] = None, final: bool = False) -> dict:
         """The per-step-boundary agreed verdict.
 
         Every rank contributes its local state; rank 0 reduces worst-wins
@@ -710,7 +806,28 @@ class Coordinator:
         loss, step ms) on the verdict this exchange already carries — rank 0
         exposes the gathered `{rank: info}` as `self.last_infos`, so a
         merged cross-rank record costs NO new collective. A rank that
-        passes no info keeps the historical bare-string wire value."""
+        passes no info keeps the historical bare-string wire value.
+
+        Cadence ($BNSGCN_COORD_AGREE_EVERY = K): only every K-th call (and
+        a `final=True` call — the last step boundary, so a latched verdict
+        can never die with the run) performs the exchange; in between the
+        worst local state latches and an immediate `{'decision': 'ok',
+        'deferred': True}` is returned. Verdict latency is therefore at
+        most K step boundaries. K=1 (default) is exactly the historical
+        every-boundary behavior.
+
+        Elastic mode: a peer whose process is provably dead is imputed
+        state 'lost' instead of timing out the exchange; worst-wins then
+        maps it to a RESIZE decision (decide_fn supplies the payload)."""
+        if (STATE_PRIORITY.get(state, 99)
+                > STATE_PRIORITY.get(self._latched, 0)):
+            self._latched = state
+        calls = self._agree_calls
+        self._agree_calls += 1
+        if not final and (calls + 1) % self.agree_every != 0:
+            return {"decision": "ok", "epoch": int(epoch), "deferred": True}
+        state = self._latched
+        self._latched = "ok"
         seq = self._seq
         self._seq += 1
         self.heartbeat(epoch, self.STEP_KEY)
@@ -730,12 +847,26 @@ class Coordinator:
 
             states = {0: state}
             self.last_infos = {0: info} if info is not None else {}
-            for r in range(1, self.world):
-                s, i = _parse(self._get(f"v/{seq}/{r}", deadline,
-                                        f"rank {r}'s epoch-{epoch} verdict"))
-                states[r] = s
-                if i is not None:
-                    self.last_infos[r] = i
+            lost: list[int] = []
+            if self.elastic:
+                vals, lost = self._gather_elastic(
+                    {r: f"v/{seq}/{r}" for r in self._peers()}, deadline,
+                    lambda r: f"rank {r}'s epoch-{epoch} verdict")
+                for r in sorted(vals):
+                    s, i = _parse(vals[r])
+                    states[r] = s
+                    if i is not None:
+                        self.last_infos[r] = i
+                for r in lost:
+                    states[r] = "lost"
+            else:
+                for r in self._peers():
+                    s, i = _parse(self._get(
+                        f"v/{seq}/{r}", deadline,
+                        f"rank {r}'s epoch-{epoch} verdict"))
+                    states[r] = s
+                    if i is not None:
+                        self.last_infos[r] = i
             name = reduce_states(states)
             decision = {"decision": name, "epoch": int(epoch),
                         "states": {str(r): s for r, s in states.items()}}
@@ -760,21 +891,31 @@ class Coordinator:
         terminal = decision.get("decision", "ok") != "ok"
         if terminal:
             # fresh window: a late-arriving decision (slow decide_fn) must
-            # not leave the confirm with an already-expired deadline
-            self._confirm(seq, self._deadline())
-        self._retire(seq, [f"v/{seq}/{r}" for r in range(self.world)]
+            # not leave the confirm with an already-expired deadline.
+            # A RESIZE verdict's confirm set excludes the ranks it just
+            # declared lost — their death is the verdict; waiting a full
+            # deadline on each would stall every survivor.
+            gone = {int(r) for r in decision.get("lost", [])}
+            self._confirm(seq, self._deadline(),
+                          ranks=[r for r in self.members if r not in gone])
+        self._retire(seq, [f"v/{seq}/{r}" for r in self.members]
                      + [f"d/{seq}"]
-                     + ([f"c/{seq}/{r}" for r in range(self.world)]
+                     + ([f"c/{seq}/{r}" for r in self.members]
                         if terminal else []))
         return decision
 
-    def _confirm(self, seq: int, deadline: float):
-        """All ranks acknowledge a terminal decision; rank 0 waits (best
-        effort — a peer that died before confirming must not block the
-        survivors' orderly exit past the deadline)."""
+    def _confirm(self, seq: int, deadline: float,
+                 ranks: Optional[list[int]] = None):
+        """All (surviving) ranks acknowledge a terminal decision; rank 0
+        waits (best effort — a peer that died before confirming must not
+        block the survivors' orderly exit past the deadline). `ranks`
+        narrows the wait set: a RESIZE must not spend a deadline waiting
+        for the very rank whose death it just agreed on."""
         self._put(f"c/{seq}/{self.rank}", "1", deadline)
         if self.rank == 0:
-            for r in range(1, self.world):
+            for r in (self.members if ranks is None else ranks):
+                if r == 0:
+                    continue
                 try:
                     self._get(f"c/{seq}/{r}", deadline,
                               f"rank {r}'s decision confirmation")
@@ -818,12 +959,31 @@ class Coordinator:
             # verdict fetch below.
             gather_dl = self._deadline(2 * self.timeout_s)
             fails: dict[int, str] = {}
-            for r in range(self.world):
-                got = json.loads(self._get(
-                    f"a/{name}/{seq}/{r}", gather_dl,
-                    f"rank {r}'s {name!r} ack"))
-                if not got.get("ok"):
-                    fails[r] = str(got.get("detail", ""))
+            if self.elastic:
+                vals, lost = self._gather_elastic(
+                    {r: f"a/{name}/{seq}/{r}" for r in self._peers()},
+                    gather_dl, lambda r: f"rank {r}'s {name!r} ack")
+                vals[self.rank] = json.dumps({"ok": bool(ok),
+                                              "detail": detail})
+                for r in lost:
+                    # a peer that died mid-ack: impute success so the
+                    # survivors' exchange completes — the next agree
+                    # boundary re-detects the death and resolves it as a
+                    # RESIZE verdict instead of stranding this ack
+                    self.log(f"[coord] rank {r} died before acking "
+                             f"{name!r}; deferring the loss to the next "
+                             f"agree boundary")
+                for r in sorted(vals):
+                    got = json.loads(vals[r])
+                    if not got.get("ok"):
+                        fails[r] = str(got.get("detail", ""))
+            else:
+                for r in self.members:
+                    got = json.loads(self._get(
+                        f"a/{name}/{seq}/{r}", gather_dl,
+                        f"rank {r}'s {name!r} ack"))
+                    if not got.get("ok"):
+                        fails[r] = str(got.get("detail", ""))
             verdict = {"ok": not fails,
                        "fails": {str(r): d for r, d in fails.items()}}
             self._put(f"ad/{name}/{seq}", json.dumps(verdict), deadline)
@@ -841,9 +1001,9 @@ class Coordinator:
             # Fresh window: a late-arriving verdict must not leave the
             # confirm already expired (exit 77 masking the agreed 78).
             self._confirm(seq, self._deadline())
-        self._retire(seq, [f"a/{name}/{seq}/{r}" for r in range(self.world)]
+        self._retire(seq, [f"a/{name}/{seq}/{r}" for r in self.members]
                      + [f"ad/{name}/{seq}"]
-                     + ([f"c/{seq}/{r}" for r in range(self.world)]
+                     + ([f"c/{seq}/{r}" for r in self.members]
                         if not verdict["ok"] else []))
         return (bool(verdict["ok"]),
                 {int(r): d for r, d in verdict.get("fails", {}).items()})
@@ -858,7 +1018,7 @@ class Coordinator:
             deadline = self._deadline()
             self._put(f"fin/{self.rank}", "1", deadline)
             if self.rank == 0:
-                for r in range(1, self.world):
+                for r in self._peers():
                     try:
                         self._get(f"fin/{r}", deadline,
                                   f"rank {r}'s completion")
@@ -867,6 +1027,162 @@ class Coordinator:
                                  f"completion; closing anyway")
         except CoordError:
             pass
+
+    # -- elastic membership: RESIZE verdicts and the rejoin handshake --
+    #
+    # Key namespaces OUTSIDE the seq-space collectives (so a joiner can talk
+    # to the incumbent run before it holds a seq position):
+    #   el/boot       rank 0's bootstrap facts (the seed) a replacement
+    #                 needs before it can build anything
+    #   el/lost/<r>   persistent beacon: rank r was resized away; its
+    #                 replacement probes this to pick the rejoin path
+    #   rj/req/<r>    joiner -> rank 0: ready to rejoin (carries a fresh
+    #                 per-incarnation token)
+    #   rj/ack/<r>    rank 0 -> joiner: the grow grant (echoes the token;
+    #                 a stale grant from an earlier incarnation is ignored)
+
+    def enable_elastic(self, min_world: int = 1):
+        self.elastic = True
+        self.min_world = max(1, int(min_world))
+
+    def publish_boot(self, payload: dict):
+        """Rank 0, elastic: persist the run's bootstrap facts for future
+        replacement ranks (kept for the whole run — never retired)."""
+        self._put("el/boot", json.dumps(dict(payload)))
+
+    def boot_info(self) -> dict:
+        return json.loads(self._get("el/boot", self._deadline(),
+                                    "the elastic boot record"))
+
+    def detect_rejoin(self) -> bool:
+        """Replacement-rank startup probe: this rank was declared lost by an
+        incumbent run iff rank 0 left an `el/lost/<rank>` beacon. One
+        bounded probe ($BNSGCN_ELASTIC_JOIN_PROBE_S, default 5 s — that is
+        only the connect-retry budget; a live server answers instantly).
+        Relaunch replacements AFTER the shrink verdict lands (watch for the
+        resize obs event), or raise the probe window."""
+        probe = float(os.environ.get("BNSGCN_ELASTIC_JOIN_PROBE_S", 5.0))
+        try:
+            return self.transport.try_get(f"el/lost/{self.rank}",
+                                          self._deadline(probe)) is not None
+        except CoordError:
+            return False
+
+    def apply_resize(self, decision: dict):
+        """Adopt an agreed RESIZE: update the member set; rank 0 marks the
+        lost ranks (the beacon their replacements probe) and clears their
+        stale rejoin keys. Survivors call this BEFORE the resize ack
+        exchange so a grow's joiner is already in the gather set."""
+        members = tuple(int(r) for r in decision["members"])
+        gone = [r for r in self.members if r not in members]
+        joined = [r for r in members if r not in self.members]
+        self.members = members
+        self.world = len(members)
+        if self.rank == 0:
+            self._lost.update(gone)
+            self._lost.difference_update(joined)
+            deadline = self._deadline(min(5.0, self.timeout_s))
+            for r in gone:
+                try:
+                    self._put(f"el/lost/{r}", json.dumps({"seq": self._seq}),
+                              deadline)
+                    self.transport.delete(f"rj/req/{r}", deadline)
+                    self.transport.delete(f"rj/ack/{r}", deadline)
+                except (CoordError, OSError):
+                    pass    # best-effort: a missed beacon only delays rejoin
+            for r in joined:
+                try:
+                    # the grant (rj/ack) stays — the joiner may still be
+                    # reading it; its token goes stale with the next req
+                    self.transport.delete(f"el/lost/{r}", deadline)
+                except (CoordError, OSError):
+                    pass
+        self.log(f"[coord] world resized to {self.world} "
+                 f"(members {list(self.members)}"
+                 + (f", lost {gone}" if gone else "")
+                 + (f", rejoined {joined}" if joined else "") + ")")
+
+    def poll_rejoin(self) -> list[tuple[int, str]]:
+        """Rank 0, at an agree boundary: pending rejoin requests from lost
+        ranks. A request for a rank still in `members` is a replacement
+        racing an undetected death — ignored until the loss verdict lands
+        (the stale-incumbent's silence resolves it within dead_after_s)."""
+        if not self._lost:
+            return []
+        out = []
+        deadline = self._deadline(min(5.0, self.timeout_s))
+        for r in sorted(self._lost):
+            try:
+                v = self.transport.try_get(f"rj/req/{r}", deadline)
+            except CoordError:
+                continue
+            if v is None:
+                continue
+            try:
+                tok = str(json.loads(v).get("token", ""))
+            except ValueError:
+                continue
+            if tok:
+                out.append((r, tok))
+        return out
+
+    def grant_rejoin(self, rank: int, token: str, payload: dict):
+        """Rank 0 (inside the grow decide): answer `rank`'s rejoin request.
+        The grant echoes the joiner's token so only THIS incarnation of the
+        replacement adopts it."""
+        body = dict(payload)
+        body["token"] = str(token)
+        self._put(f"rj/ack/{rank}", json.dumps(body))
+        try:
+            self.transport.delete(f"rj/req/{rank}",
+                                  self._deadline(min(5.0, self.timeout_s)))
+        except (CoordError, OSError):
+            pass
+
+    def request_rejoin(self, token: str,
+                       info: Optional[dict] = None) -> dict:
+        """Replacement rank: announce readiness and block until rank 0's
+        grant for THIS incarnation. Grants carrying any other token are
+        stale (minted for an earlier, dead replacement) and are skipped —
+        the wait continues until rank 0 answers the fresh request. Bounded
+        by $BNSGCN_ELASTIC_JOIN_WAIT_S (default 2x the exchange timeout);
+        the window must cover rank 0 reaching its next agree boundary."""
+        self._put(f"rj/req/{self.rank}",
+                  json.dumps({"token": str(token), "info": info or {}}))
+        wait_s = float(os.environ.get("BNSGCN_ELASTIC_JOIN_WAIT_S",
+                                      2 * self.timeout_s))
+        deadline = self._deadline(wait_s)
+        delay = 0.002
+        while True:
+            try:
+                v = self.transport.try_get(f"rj/ack/{self.rank}", deadline)
+            except CoordTimeout:
+                v = None
+            if v is not None:
+                try:
+                    grant = json.loads(v)
+                except ValueError:
+                    grant = {}
+                if str(grant.get("token", "")) == str(token):
+                    return grant
+                # stale grant from a previous incarnation: keep waiting
+            if self._clock() >= deadline:
+                self.log_liveness()
+                raise CoordTimeout(
+                    f"rank {self.rank}: no rejoin grant within {wait_s:.1f}s "
+                    f"(is the incumbent run still alive and elastic?)")
+            self._sleep(min(delay, max(deadline - self._clock(), 0)))
+            delay = min(delay * 2, 0.05)
+
+    def adopt_grant(self, grant: dict):
+        """Joiner: step into the incumbent run's collective schedule at the
+        seq / agree-cadence position the grant names. After this, the very
+        next collective call lands in lockstep with the survivors'."""
+        self.members = tuple(int(r) for r in grant["members"])
+        self.world = len(self.members)
+        self._seq = int(grant["seq"])
+        self._agree_calls = int(grant.get("agree_calls", 0))
+        self._latched = "ok"
 
     def close(self):
         if not self._closed:

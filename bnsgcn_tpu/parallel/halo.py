@@ -90,6 +90,16 @@ class HaloSpec:
                                        # shard_map over a 2-D mesh a
                                        # parts-axis collective acts within
                                        # each replica's own sub-group.
+    slot_map: tuple = ()               # [P] part -> hosting worker slot for
+                                       # elastic worlds (mesh.plan_slots).
+                                       # Host-side addressing metadata ONLY:
+                                       # the traced programs keep the full
+                                       # P-wide 'parts' axis regardless, and
+                                       # nothing inside traced code reads
+                                       # this field, so the compiled schedule
+                                       # is slot-invariant (pinned by the
+                                       # graftlint-ir slot-map section).
+                                       # () = identity (worker == part).
 
     @property
     def n_halo(self) -> int:
@@ -99,7 +109,8 @@ class HaloSpec:
 def make_halo_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
                    rate: float, axis_name: str = "parts",
                    strategy: str = "padded", wire: str = "native",
-                   replica_axis: str | None = None
+                   replica_axis: str | None = None,
+                   slot_map=None
                    ) -> tuple[HaloSpec, dict]:
     """Derive fixed send sizes and ratios from boundary sizes + sampling rate
     (reference get_send_size/get_recv_size, train.py:107-131).
@@ -131,6 +142,7 @@ def make_halo_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
         strategy=strategy, wire=wire, shift_pads=tuple(shift_pads),
         pair_send=tuple(map(tuple, send_size.tolist())),
         replica_axis=replica_axis,
+        slot_map=tuple(int(s) for s in (slot_map or ())),
     )
     tables = {"n_b": jnp.asarray(n_b, jnp.int32),
               "send_size": jnp.asarray(send_size, jnp.int32),
@@ -189,6 +201,26 @@ def traced_wire_bytes(spec: HaloSpec, width: int, native_bytes: int = 4,
             return t_pad * width * b
         return spec.n_parts * spec.pad_send * width * b
     return wire_bytes(spec, width, native_bytes)
+
+
+def cross_slot_wire_bytes(spec: HaloSpec, width: int,
+                          native_bytes: int = 4) -> int:
+    """Per-device halo bytes that actually cross WORKER boundaries under an
+    elastic part->slot mapping: pairs hosted on the same slot move through
+    that worker's own HBM, not the interconnect. Exact pair_send rows (no
+    padding — this is the planning/obs view of a resized world's wire cost,
+    not the traced operand size). With an empty slot_map (identity, worker
+    == part) only the self pair is intra-slot, matching `_ragged_exact_rows`
+    accounting. Returns the bottleneck slot's worst part, summed over its
+    cross-slot peers."""
+    b = {"native": native_bytes, "bf16": 2, "fp8": 1, "int8": 1}[spec.wire]
+    P = spec.n_parts
+    slots = spec.slot_map or tuple(range(P))
+    S = np.asarray(spec.pair_send, dtype=np.int64).reshape(P, P)
+    rows = np.zeros(P, dtype=np.int64)
+    for p in range(P):
+        rows[p] = sum(int(S[p, q]) for q in range(P) if slots[q] != slots[p])
+    return int(rows.max()) * width * b if P else 0
 
 
 # auto-selection thresholds: ragged must save >=5% of padded's cross-chip
@@ -350,7 +382,8 @@ def make_halo_plan(spec: HaloSpec, tables: dict, bnd: jax.Array,
 def make_refresh_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
                       rate: float, refresh: int, axis_name: str = "parts",
                       strategy: str = "padded", wire: str = "native",
-                      replica_axis: str | None = None
+                      replica_axis: str | None = None,
+                      slot_map=None
                       ) -> tuple[HaloSpec, dict]:
     """Geometry + tables for the --halo-refresh K partial exchange.
 
@@ -407,6 +440,7 @@ def make_refresh_spec(n_b: np.ndarray, pad_inner: int, pad_boundary: int,
         strategy=strategy, wire=wire, shift_pads=tuple(shift_pads),
         pair_send=tuple(map(tuple, pair_send.tolist())),
         replica_axis=replica_axis,
+        slot_map=tuple(int(s) for s in (slot_map or ())),
     )
     tables = {"n_b": jnp.asarray(n_bc, jnp.int32),
               "send_size": jnp.asarray(s_c, jnp.int32),

@@ -113,6 +113,21 @@ def mesh_desc(mesh: Mesh) -> str:
             + " x ".join(label for _, label in present))
 
 
+def slot_desc(slot_map, members) -> str:
+    """Human-readable elastic hosting layout for resize logs/obs:
+    'rank0:[p0,p1] rank1:[p2,p3]'. `slot_map` is the [P] part ->
+    hosting-rank tuple a RESIZE decision carries in 'slots'
+    (members[plan_slots(P, W)[p]], resilience.plan_resize); `members` is
+    the member rank list, used only for the identity default when
+    slot_map is empty (worker == part, today's layout)."""
+    from bnsgcn_tpu.parallel.mesh import slot_members
+    ranks = tuple(slot_map) if slot_map else tuple(members)
+    by_rank = slot_members(ranks)
+    return " ".join(
+        f"rank{r}:[{','.join(f'p{p}' for p in parts)}]"
+        for r, parts in sorted(by_rank.items()))
+
+
 def stacked_spec(mesh: Mesh) -> P:
     """PartitionSpec stacking per-device rows along dim 0: every mesh axis
     together (global [R*P*T, ...], replica-major / feat-minor), plain

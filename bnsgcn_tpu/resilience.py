@@ -53,6 +53,10 @@ the CLI surface:
   BNSGCN_WATCHDOG_MIN_S     deadline floor after the first step (300)
   BNSGCN_RETRY_BACKOFF_S    rollback backoff base, doubled per retry (1.0)
   BNSGCN_COORD_TIMEOUT_S    per-exchange coordinator deadline (120)
+  BNSGCN_COORD_AGREE_EVERY  agree every K step boundaries, latching local
+                            verdicts in between (1)
+  BNSGCN_ELASTIC_DEAD_S     alive-beat age that proves a peer dead (6)
+  BNSGCN_ELASTIC_MAX_RESIZES  resize budget per run before abort (8)
 """
 
 from __future__ import annotations
@@ -68,6 +72,7 @@ from typing import Optional
 
 from bnsgcn_tpu import checkpoint as ckpt
 from bnsgcn_tpu import obs as obs_mod
+from bnsgcn_tpu.config import ConfigError
 from bnsgcn_tpu.parallel.coord import CoordAbort
 
 # Distinct exit codes so a requeue wrapper (the tools/tpu_watchdog5.sh role,
@@ -82,7 +87,7 @@ EXIT_COORD_ABORT = 78  # ranks agreed to abort: a peer cannot restore the
                        # chosen checkpoint (rollback or resume ack) — needs
                        # triage, not a blind requeue
 
-FAULT_KINDS = ("nan", "sigterm", "hang", "ckpt-corrupt")
+FAULT_KINDS = ("nan", "sigterm", "hang", "ckpt-corrupt", "ranklost")
 
 
 class PreemptedError(Exception):
@@ -106,6 +111,19 @@ class CheckpointUnavailable(Exception):
     """A rank could not obtain the agreed restore source (no usable file,
     no snapshot). Internal to coord_restore: it is reported through the
     coordinator ack so all ranks abort together, never raised past it."""
+
+
+class RankLostExit(Exception):
+    """Raised by fire_injections when this rank's scheduled `ranklost`
+    fault fires: the process unwinds WITHOUT the orderly coordinator
+    goodbye (no fin barrier, no final agree) and main.py exits 0 — to its
+    peers it is indistinguishable from a preempted worker whose alive-beats
+    stopped, which is exactly the heartbeat-silence path the elastic
+    RESIZE detection must prove."""
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
+        super().__init__(f"rank lost (injected) at epoch {epoch}")
 
 
 # ----------------------------------------------------------------------------
@@ -229,6 +247,13 @@ class FaultPlan:
                 raise ValueError(
                     f"unknown --inject fault {kind!r} "
                     f"(kinds: {', '.join(FAULT_KINDS)})")
+            if kind == "ranklost" and not rsep:
+                # rank-less faults mean "fire on every rank" — losing ALL
+                # ranks is not a resize, so the grammar refuses it up front
+                raise ConfigError(
+                    f"--inject term {term!r}: ranklost needs an explicit "
+                    f":r<rank> target (losing every rank is not a resize); "
+                    f"use ranklost@E<epoch>:r<rank>")
             if rsep and int(rk[1:]) != rank:
                 continue                # valid term, targets another rank
             plan.faults.setdefault(kind, set()).add(int(ep[1:]))
@@ -424,7 +449,8 @@ class ResilienceManager:
     routed through `agree_step` so all ranks act together."""
 
     def __init__(self, cfg, log=print, start_epoch: int = 0,
-                 retry_nonce: int = 0, coord=None, obs=None):
+                 retry_nonce: int = 0, coord=None, obs=None,
+                 resize_nonce: int = 0):
         self.cfg = cfg
         self.log = log
         self.start_epoch = start_epoch
@@ -451,6 +477,18 @@ class ResilienceManager:
         self.backoff_base = float(os.environ.get("BNSGCN_RETRY_BACKOFF_S", 1.0))
         self.backoff_cap = 30.0
         self.rollbacks: list[dict] = []     # surfaced on RunResult
+        # elastic world size (--elastic on + a coordinator): rank loss
+        # becomes a RESIZE verdict instead of CoordTimeout->77
+        self.elastic = (getattr(cfg, "elastic", "off") == "on"
+                        and coord is not None)
+        self.resize_nonce = resize_nonce    # restore-carrying resizes so
+                                            # far; folds the key streams
+                                            # under a domain disjoint from
+                                            # the retry nonce's (persisted
+                                            # in ckpt extra like it)
+        self.resizes = 0
+        self.max_resizes = int(os.environ.get(
+            "BNSGCN_ELASTIC_MAX_RESIZES", 8))
         self._signals = PreemptSignals(action="checkpoint",
                                        profile=obs is not None)
         # decide/ack seam: the rollback paths reach checkpoint I/O and the
@@ -612,7 +650,8 @@ class ResilienceManager:
     # -- multi-host agreed verdicts (coord != None) --
 
     def agree_step(self, epoch: int, state: str, loss_f: float = 0.0,
-                   summary: Optional[dict] = None) -> dict:
+                   summary: Optional[dict] = None,
+                   final: bool = False) -> dict:
         """One step-boundary verdict exchange: contribute this rank's local
         state ('ok' | 'diverged' | 'preempted'), return the agreed decision
         every rank acts on. Rank 0 owns the reduce and — for 'rollback' —
@@ -623,10 +662,20 @@ class ResilienceManager:
         `summary` (obs on only) piggybacks this rank's epoch telemetry
         (loss, step ms) on the verdict value the exchange already carries;
         rank 0 merges every rank's summary into ONE `epoch_ranks` event —
-        cross-rank per-epoch accounting with zero extra collectives."""
+        cross-rank per-epoch accounting with zero extra collectives.
+
+        `final` marks the run's last step boundary: the coordinator's agree
+        cadence ($BNSGCN_COORD_AGREE_EVERY) always exchanges there, so a
+        latched verdict can never die with the run.
+
+        Elastic mode additionally resolves an imputed 'lost' peer into a
+        RESIZE decision (plan_resize), and — at a clean boundary — answers
+        a pending rejoin request with a grow RESIZE (plan_grow)."""
         decide = None
         if self.coord.rank == 0:
             def decide(name, states):
+                if name == "resize":
+                    return self.plan_resize(epoch, states, loss_f)
                 if name == "rollback":
                     return self.plan_rollback(epoch, loss_f, states)
                 if name == "preempt":
@@ -635,17 +684,52 @@ class ResilienceManager:
                 if name == "abort":
                     return {"decision": "abort", "why": "peer",
                             "report": f"a rank reported abort: {states}"}
+                if self.elastic:
+                    # a clean boundary is the only admission point: the
+                    # joiner steps into the NEXT collective, so the member
+                    # set must change exactly here, through the same
+                    # agree/confirm machinery every other verdict uses
+                    for r, tok in self.coord.poll_rejoin():
+                        return self.plan_grow(epoch, r, tok)
                 return {"decision": "ok"}
-        decision = self.coord.agree(epoch, state, decide, info=summary)
+        decision = self.coord.agree(epoch, state, decide, info=summary,
+                                    final=final)
         if (self.obs is not None and self.coord.rank == 0
+                and not decision.get("deferred")
                 and self.coord.last_infos):
             self.obs.emit("epoch_ranks", epoch=int(epoch),
                           decision=decision.get("decision", "ok"),
                           ranks={str(r): i for r, i in
                                  sorted(self.coord.last_infos.items())})
-        if decision.get("decision", "ok") != "ok":
+        if (decision.get("decision", "ok") != "ok"
+                and not decision.get("deferred")):
             self._emit("coord_decision", epoch=int(epoch),
                        decision=decision["decision"], local_state=state)
+        if decision["decision"] == "resize":
+            if self.coord.rank in [int(r) for r in decision.get("lost", [])]:
+                raise CoordAbort(
+                    f"rank {self.coord.rank} was declared lost by the "
+                    f"resize verdict while still alive — its alive-beats "
+                    f"stalled past {self.coord.dead_after_s:.1f}s (raise "
+                    f"$BNSGCN_ELASTIC_DEAD_S if the host is just slow)")
+            self.resize_nonce = int(decision.get("nonce", self.resize_nonce))
+            if self.coord.rank != 0:
+                self.log(
+                    f"[resilience] agreed resize (decided by rank 0): world "
+                    f"{decision['old_world']} -> {decision['world']} "
+                    f"({decision['trigger']}), restart "
+                    f"{decision['restart']} from {decision['source']}, "
+                    f"resize-nonce {self.resize_nonce}")
+            self._emit("resize", epoch=int(decision["epoch"]),
+                       old_world=int(decision["old_world"]),
+                       world=int(decision["world"]),
+                       members=[int(r) for r in decision["members"]],
+                       lost=[int(r) for r in decision.get("lost", [])],
+                       slots=[int(s) for s in decision.get("slots", [])],
+                       trigger=str(decision["trigger"]),
+                       nonce=int(decision.get("nonce", 0)),
+                       restart=int(decision["restart"]),
+                       source=str(decision["source"]))
         if decision["decision"] == "rollback" and self.coord.rank != 0:
             self.nonce = int(decision["nonce"])
             self.rollbacks.append({
@@ -712,8 +796,102 @@ class ResilienceManager:
                 "backoff_s": min(self.backoff_cap,
                                  self.backoff_base * (2 ** (self.retries - 1)))}
 
+    def _pick_restore(self, epoch: int) -> tuple[int, str]:
+        """Newest valid checkpoint strictly before `epoch`'s boundary (or
+        the initial snapshot): the restore target a RESIZE carries. Sets
+        `_pending_payload` exactly like plan_rollback so rank 0's
+        coord_restore never re-reads the file it just validated."""
+        found = self._find_ckpt(self.cfg, log=self.log, before_epoch=epoch)
+        if found is not None:
+            path, self._pending_payload = found
+            return int(self._pending_payload["epoch"]) + 1, \
+                os.path.basename(path)
+        self._pending_payload = None
+        return self.start_epoch, "<initial state>"
+
+    def plan_resize(self, epoch: int, states: dict,
+                    loss_f: float = 0.0) -> dict:
+        """Rank 0's shrink verdict: peers imputed 'lost' are dropped from
+        the member set, every survivor restores the newest valid checkpoint
+        (or the initial snapshot) and refolds its key streams under a fresh
+        resize nonce, and the P parts are re-mapped onto the survivor slots
+        (contiguous balanced blocks — no METIS rerun). Falls back to an
+        agreed abort when the survivors cannot cover the minimum world or
+        the resize budget is exhausted — a flapping pod must fail loudly,
+        not thrash forever."""
+        from bnsgcn_tpu.parallel.mesh import plan_slots
+        lost = sorted(int(r) for r, s in states.items() if s == "lost")
+        survivors = [r for r in self.coord.members if r not in lost]
+        self.resizes += 1
+        if self.resizes > self.max_resizes:
+            return {"decision": "abort", "why": "peer",
+                    "report": f"resize budget exhausted "
+                              f"({self.max_resizes} per run, "
+                              f"$BNSGCN_ELASTIC_MAX_RESIZES): rank(s) "
+                              f"{lost} lost at epoch {epoch}"}
+        if len(survivors) < max(self.coord.min_world, 1):
+            return {"decision": "abort", "why": "peer",
+                    "report": f"rank(s) {lost} lost at epoch {epoch} but "
+                              f"only {len(survivors)} survivor(s) remain "
+                              f"(--elastic-min-world "
+                              f"{self.coord.min_world})"}
+        restart, src = self._pick_restore(epoch)
+        self.resize_nonce += 1
+        n_parts = int(getattr(self.cfg, "n_partitions", len(survivors)))
+        slots = [survivors[s] for s in plan_slots(n_parts, len(survivors))]
+        self.log(
+            f"[resilience] rank(s) {lost} lost at epoch {epoch}: agreed "
+            f"resize, world {len(self.coord.members)} -> {len(survivors)} "
+            f"(survivors {survivors}), all survivors restart at epoch "
+            f"{restart} from {src} with resize-nonce {self.resize_nonce} "
+            f"folded into the sampling/dropout keys")
+        return {"decision": "resize", "trigger": "ranklost",
+                "epoch": int(epoch),
+                "old_world": len(self.coord.members),
+                "world": len(survivors), "members": survivors,
+                "lost": lost, "slots": slots, "restart": int(restart),
+                "source": src, "retry_nonce": int(self.nonce),
+                "nonce": int(self.resize_nonce), "backoff_s": 0.0}
+
+    def plan_grow(self, epoch: int, rank: int, token: str) -> dict:
+        """Rank 0's grow verdict: admit `rank`'s replacement back into the
+        member set. Every member (the joiner included — its grant names the
+        same source) restores the newest valid checkpoint and replays from
+        it; the folds are untouched (NO new resize nonce), so the replay
+        deterministically lands back on the survivors' own trajectory and
+        the final loss is independent of when the rejoin happened. The
+        grant additionally carries the seq / agree-call position so the
+        joiner's next collective is already in lockstep."""
+        from bnsgcn_tpu.parallel.mesh import plan_slots
+        members = sorted(set(self.coord.members) | {int(rank)})
+        restart, src = self._pick_restore(epoch)
+        n_parts = int(getattr(self.cfg, "n_partitions", len(members)))
+        slots = [members[s] for s in plan_slots(n_parts, len(members))]
+        decision = {"decision": "resize", "trigger": "rejoin",
+                    "epoch": int(epoch),
+                    "old_world": len(self.coord.members),
+                    "world": len(members), "members": members,
+                    "lost": [], "joined": [int(rank)], "slots": slots,
+                    "restart": int(restart), "source": src,
+                    "retry_nonce": int(self.nonce),
+                    "nonce": int(self.resize_nonce), "backoff_s": 0.0}
+        grant = dict(decision)
+        # the joiner's schedule position: agree() already advanced both
+        # counters for THIS exchange, so the values here are exactly where
+        # every survivor will stand when it acts on the decision
+        grant["seq"] = self.coord._seq
+        grant["agree_calls"] = self.coord._agree_calls
+        self.coord.grant_rejoin(int(rank), token, grant)
+        self.log(
+            f"[resilience] rank {rank} rejoined at epoch {epoch}: agreed "
+            f"resize, world {len(self.coord.members)} -> {len(members)}, "
+            f"all members restart at epoch {restart} from {src} (folds "
+            f"unchanged — the replay rejoins the same trajectory)")
+        return decision
+
     def coord_restore(self, decision: dict, params_t, opt_t, state_t,
-                      restore_local: bool = True):
+                      restore_local: bool = True,
+                      ack_name: str = "rollback"):
         """Every rank's half of a coordinated rollback: sleep the agreed
         backoff, restore the decision's source from the local checkpoint
         dir (rank 0 reuses the payload plan_rollback already validated; the
@@ -754,10 +932,10 @@ class ResilienceManager:
                          f"{src}: {err}")
             finally:
                 self._pending_payload = None
-        all_ok, fails = self.coord.gather_ok("rollback", ok, err)
+        all_ok, fails = self.coord.gather_ok(ack_name, ok, err)
         if not all_ok:
             raise CoordAbort(
-                "coordinated rollback failed — rank(s) could not restore "
+                f"coordinated {ack_name} failed — rank(s) could not restore "
                 f"{src!r}: "
                 + "; ".join(f"rank {r}: {d}" for r, d in sorted(fails.items())))
         return out
@@ -794,6 +972,12 @@ class ResilienceManager:
                 self.log(f"[inject] ckpt-corrupt@E{epoch}: tore {latest}")
             else:
                 self.log(f"[inject] ckpt-corrupt@E{epoch}: no checkpoint yet")
+        if self.plan.pop("ranklost", epoch):
+            self.log(f"[inject] ranklost@E{epoch}: dropping this rank with "
+                     f"no coordinator goodbye — peers must detect the "
+                     f"heartbeat silence")
+            self._emit("inject", kind_injected="ranklost", epoch=int(epoch))
+            raise RankLostExit(epoch)
         if self.plan.pop("hang", epoch):
             self.log(f"[inject] hang@E{epoch}: blocking the step (watchdog "
                      f"deadline {self.watchdog.deadline_s():.1f}s)")

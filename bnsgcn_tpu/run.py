@@ -48,7 +48,7 @@ from bnsgcn_tpu.models.gnn import ModelSpec, spec_from_config
 from bnsgcn_tpu.parallel import coord as coord_mod
 from bnsgcn_tpu.parallel import feat as feat_mod
 from bnsgcn_tpu.parallel.mesh import replicated_sharding
-from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc
+from bnsgcn_tpu.parallel.replicas import make_mesh, mesh_desc, slot_desc
 from bnsgcn_tpu.trainer import (LAST_BUILD_TIMINGS, build_block_arrays,
                                 build_step_fns, init_training,
                                 local_part_ids, param_global_norm, place_blocks,
@@ -197,6 +197,34 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # in a real multi-host run
             is_rank0 = coord_rank == 0
 
+    # ---- elastic world size (--elastic on): a heartbeat-detected rank
+    # loss becomes a coordinated RESIZE verdict (re-map the P parts onto
+    # the survivors via mesh.plan_slots, rebuild step fns, resume from the
+    # agreed checkpoint) instead of a CoordTimeout exit. Harness-mode only:
+    # a real jax.distributed pod cannot reshape its process grid in place.
+    # `joiner` marks a process relaunched AFTER a shrink verdict — it must
+    # not replay the pre-loop collectives (those seq-space keys are retired
+    # on the survivors) and instead re-enters through the rejoin handshake
+    # below the resume block.
+    joiner = False
+    if cfg.elastic == "on":
+        if coordinator is None:
+            raise ConfigError(
+                "--elastic on needs the rank coordinator: run with "
+                "--resilience on and --coord tcp|file (got --coord "
+                f"{cfg.coord}, --resilience {cfg.resilience})")
+        if multi_host:
+            raise ConfigError(
+                "--elastic on is harness-mode only (--coord-world/"
+                "--coord-rank): a jax.distributed process grid cannot be "
+                "resized in place")
+        coordinator.enable_elastic(cfg.elastic_min_world)
+        if coord_rank != 0:
+            joiner = coordinator.detect_rejoin()
+            if joiner:
+                log(f"[elastic] rank {coord_rank}: rejoining a resized "
+                    f"world (lost-rank beacon found)")
+
     # ---- telemetry bus (obs.py): rank-tagged structured event log +
     # metrics registry. None under --obs off — every emit below is guarded,
     # so off constructs nothing and stays bit-identical (pinned). ----
@@ -264,6 +292,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             if coord_rank == 0:
                 art = prepare_partition(cfg, train_g)
                 coordinator.broadcast("parts-ready", {"ok": 1})
+            elif joiner:
+                # rejoining rank: the parts-ready broadcast key was retired
+                # long ago on the survivors; the artifacts are already on
+                # disk from the original build, so load them directly
+                art = prepare_partition(cfg, train_g)
             else:
                 coordinator.broadcast("parts-ready")
                 art = prepare_partition(cfg, train_g)
@@ -597,12 +630,19 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
 
     # ---- model / optimizer init, optionally resumed ----
     seed = cfg.seed
-    if coordinator is not None and not multi_host:
+    if joiner:
+        # rejoining rank: the seed broadcast key is long retired on the
+        # survivors; rank 0's bootstrap facts live under the never-retired
+        # el/boot key exactly so late joiners can adopt the run seed
+        seed = int(coordinator.boot_info()["seed"])
+    elif coordinator is not None and not multi_host:
         # harness-mode analogue of main.py's XLA seed broadcast: every rank
         # must adopt rank 0's (possibly randomized) seed or the shared-PRNG
         # sampling/dropout/init streams desync across ranks
         seed = int(coordinator.broadcast(
             "seed", {"seed": seed} if coord_rank == 0 else None)["seed"])
+    if cfg.elastic == "on" and coord_rank == 0:
+        coordinator.publish_boot({"seed": seed})
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     params, state, opt_state = init_training(cfg, spec, mesh, seed=seed, dtype=dtype)
     # every resume/rollback below restores HOST trees back onto the mesh;
@@ -629,12 +669,17 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                         # sampling/dropout key streams (resilience.py) and
                         # round-trips through checkpoint extra so a resumed
                         # run continues the post-rollback streams bit-for-bit
+    resize_nonce = 0    # cumulative elastic-shrink count (--elastic on):
+                        # folds the same streams under a disjoint high-bit
+                        # domain so a resized world resamples its boundary
+                        # sets; 0 (never shrunk) is bit-identical. Grows
+                        # never change it — rejoin replays deterministically.
     tune_state = None   # --tune controller history from checkpoint extra:
                         # only the single-host path reads it (auto is
                         # single-process; a multi-rank schedule run
                         # reconstructs the same history from the schedule
                         # text, which every rank already has)
-    if cfg.resume and coordinator is not None:
+    if cfg.resume and coordinator is not None and not joiner:
         # ---- rank-consistent recovery: rank 0 WALKS the chain, everyone
         # else loads exactly rank 0's choice. Two ranks walking
         # independently can pick DIFFERENT files (one rank's newest local
@@ -649,11 +694,12 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             found = ckpt.latest_valid_checkpoint(cfg, log=log)
             if found:
                 path0, payload0 = found
+                _rx0 = ckpt.resilience_extra(payload0)
                 choice = {"have": 1, "file": os.path.basename(path0),
                           "epoch": int(payload0["epoch"]) + 1,
                           "seed": int(payload0.get("seed", seed)),
-                          "nonce": int((payload0.get("extra") or {})
-                                       .get("retry_nonce", 0)),
+                          "nonce": _rx0["retry_nonce"],
+                          "rnonce": _rx0["resize_nonce"],
                           "best_acc": float(payload0["best_acc"])}
             else:
                 choice = {"have": 0}
@@ -682,6 +728,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                                 for r, d in sorted(fails.items())))
             seed = int(choice["seed"])
             retry_nonce = int(choice["nonce"])
+            resize_nonce = int(choice.get("rnonce", 0))
             start_epoch = int(choice["epoch"])
             best_acc = float(choice["best_acc"])
             if multi_host:
@@ -746,21 +793,23 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             found = ckpt.latest_valid_checkpoint(cfg, log=log)
             if found:
                 payload = found[1]
-        # broadcast [next_epoch, saved_seed, retry_nonce] together: the
-        # resumed run must continue the checkpoint's BNS-sampling/dropout
-        # streams, and every process must agree on them (shared-PRNG
-        # invariant)
-        have, saved_seed, saved_nonce = (
+        # broadcast [next_epoch, saved_seed, retry_nonce, resize_nonce]
+        # together: the resumed run must continue the checkpoint's
+        # BNS-sampling/dropout streams, and every process must agree on
+        # them (shared-PRNG invariant)
+        _rx = ckpt.resilience_extra(payload) if payload is not None else {
+            "retry_nonce": 0, "resize_nonce": 0}
+        have, saved_seed, saved_nonce, saved_rnonce = (
             int(x) for x in multihost_utils.broadcast_one_to_all(
                 np.asarray(
                     [0 if payload is None else int(payload["epoch"]) + 1,
                      seed if payload is None else int(payload.get("seed", seed)),
-                     0 if payload is None else int(
-                         (payload.get("extra") or {}).get("retry_nonce", 0))],
+                     _rx["retry_nonce"], _rx["resize_nonce"]],
                     dtype=np.int64)))
         if int(have) > 0:
             seed = saved_seed
             retry_nonce = saved_nonce
+            resize_nonce = saved_rnonce
             host = ckpt.restore_into(payload, jax.device_get(params),
                                      jax.device_get(opt_state),
                                      jax.device_get(state)) if is_rank0 else (
@@ -806,8 +855,9 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             # launch, but a resumed run must continue the saved sampling and
             # dropout streams (checkpoint.py's round-trip contract)
             seed = int(payload.get("seed", seed))
-            retry_nonce = int((payload.get("extra") or {})
-                              .get("retry_nonce", 0))
+            _rx = ckpt.resilience_extra(payload)
+            retry_nonce = _rx["retry_nonce"]
+            resize_nonce = _rx["resize_nonce"]
             tune_state = (payload.get("extra") or {}).get("tune")
             log(f"Resumed from {latest} at epoch {start_epoch}")
             # recover the best-so-far params (final ckpt) so a resumed run that
@@ -851,18 +901,28 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         base_sample_key = jax.random.fold_in(base_sample_key, cyc)
         base_drop_key = jax.random.fold_in(base_drop_key, cyc)
 
-    def _fold_keys(nonce: int):
+    def _fold_keys(nonce: int, rnonce: int = 0):
         """Retry-nonce fold of the sampling/dropout streams: after the n-th
         divergence rollback every subsequent epoch draws from fold_in(base,
         n), so the retried epoch resamples its BNS boundary sets (PAPER §3:
         a diverged epoch is cheap to retry under a fresh fold) instead of
         deterministically re-diverging. nonce 0 — every run that never
-        rolled back — is the historical keys, bit-identical."""
+        rolled back — is the historical keys, bit-identical.
+
+        `rnonce` is the elastic resize nonce, folded on top under the
+        (1 << 30) high-bit domain — disjoint from both the small-int retry
+        folds and the (1 << 31) continual-cycle folds — so a shrunk world
+        draws fresh boundary sets instead of replaying the schedule that
+        straddled the loss; rnonce 0 (and every grow, which keeps the
+        nonce) stays on the unfolded streams."""
+        sk, dk = base_sample_key, base_drop_key
+        if rnonce:
+            rdom = (1 << 30) | (int(rnonce) & 0x3FFFFFFF)
+            sk = jax.random.fold_in(sk, rdom)
+            dk = jax.random.fold_in(dk, rdom)
         if nonce:
-            sk, dk = (jax.random.fold_in(base_sample_key, nonce),
-                      jax.random.fold_in(base_drop_key, nonce))
-        else:
-            sk, dk = base_sample_key, base_drop_key
+            sk, dk = (jax.random.fold_in(sk, nonce),
+                      jax.random.fold_in(dk, nonce))
         if cfg.strict_exec and jax.process_count() == 1:
             # --strict-exec: commit the keys to the mesh up front. The
             # transfer guard treats the lazy first-use resharding of an
@@ -872,7 +932,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
             sk, dk = jax.device_put(sk, sh), jax.device_put(dk, sh)
         return sk, dk
 
-    sample_key, drop_key = _fold_keys(retry_nonce)
+    sample_key, drop_key = _fold_keys(retry_nonce, resize_nonce)
 
     # ---- resilience subsystem (divergence rollback, preemption-safe
     # shutdown, hung-step watchdog, fault injection) ----
@@ -880,6 +940,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     if cfg.resilience == "on" and (not multi_host or coordinator is not None):
         resil = resilience.ResilienceManager(cfg, log, start_epoch=start_epoch,
                                              retry_nonce=retry_nonce,
+                                             resize_nonce=resize_nonce,
                                              coord=coordinator, obs=obs)
         # host snapshot of the fresh/resumed state: the rollback target
         # until the first periodic checkpoint exists (under coordination,
@@ -1016,12 +1077,22 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     # every rollback, which is what keeps --resume/rollback deterministic.
     halo_cache = None
     cache_reason = "resume" if start_epoch > 0 else "start"
+    # --elastic on: the part -> hosting-slot map agreed at the last RESIZE
+    # verdict (mesh.plan_slots over the survivors). None until a shrink;
+    # threaded into build_step_fns so rebuilt HaloSpecs carry the layout
+    # (host-side metadata only — the traced program is slot-invariant).
+    slot_map = None
 
     def _ckpt_extra():
         """Checkpoint `extra` payload: retry nonce + (under --tune) the
         controller's sticky decision history, so a resumed run replays the
-        same schedule deterministically."""
+        same schedule deterministically. The elastic resize nonce rides
+        along only when it could matter (--elastic on, or a nonzero count
+        inherited through resume) so pre-elastic checkpoints stay
+        byte-identical."""
         ex = {"retry_nonce": retry_nonce}
+        if cfg.elastic == "on" or resize_nonce:
+            ex["resize_nonce"] = resize_nonce
         if tuner is not None:
             ex["tune"] = tuner.state_dict()
         return ex
@@ -1043,7 +1114,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
         from bnsgcn_tpu.parallel.halo import make_refresh_spec, wire_bytes
         cfg = cfg.replace(**changes)
         fns, hspec, tb, tbf = build_step_fns(cfg, spec, art, mesh,
-                                             layout_cache=layout_cache)
+                                             layout_cache=layout_cache,
+                                             slot_map=slot_map)
         tables = place_replicated(tb, mesh)
         tables_full_d = place_replicated(tbf, mesh)
         tables_refresh_d = (place_replicated(fns.tables_refresh, mesh)
@@ -1073,10 +1145,11 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 fns.halo_refresh, strategy=hspec.strategy, wire=hspec.wire)
             steady_wire_mb = wire_bytes(hspec_r, hid_w, nb) / 1e6
         # the old cache was built by the OLD exchange geometry: the next
-        # epoch must be a full refresh under the new one. resume/rollback
-        # keep their own lifecycle reason; fresh decisions log as 'retune'
+        # epoch must be a full refresh under the new one. resume/rollback/
+        # resize keep their own lifecycle reason; fresh decisions log as
+        # 'retune'
         halo_cache = None
-        cache_reason = (reason if reason in ("resume", "rollback")
+        cache_reason = (reason if reason in ("resume", "rollback", "resize")
                         else "retune")
         if strict is not None and strict.steps:
             # new compiled programs: each variant's next step legitimately
@@ -1108,7 +1181,54 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                      wire_mb_steady=round(steady_wire_mb, 4),
                      wire_mb_peak=round(halo_wire_mb, 4))
 
-    if tuner is not None and start_epoch > 0:
+    if joiner:
+        # ---- rejoin handshake (--elastic on): this process replaces a
+        # rank the survivors already voted out of the world. It cannot
+        # replay the retired pre-loop collectives; instead it posts a
+        # rejoin request against the lost-rank beacon, rank 0 folds the
+        # grow verdict into its next agree boundary, and the grant carries
+        # everything needed to fall into lockstep — the agreed restore
+        # point, both nonces, the part -> rank map, and the survivors'
+        # seq/agree-call position. The first collective this rank joins is
+        # the grow restore ack, shoulder to shoulder with the survivors'
+        # own resize-arm restore. ----
+        token = f"{os.getpid():x}-{os.urandom(4).hex()}"
+        log(f"[elastic] rank {coord_rank}: requesting rejoin "
+            f"(token {token})")
+        grant = coordinator.request_rejoin(token)
+        coordinator.adopt_grant(grant)
+        restart = int(grant["restart"])
+        retry_nonce = int(grant["retry_nonce"])
+        resize_nonce = int(grant["nonce"])
+        slot_map = (tuple(int(s) for s in grant["slots"])
+                    if grant.get("slots") else None)
+        resil.nonce = retry_nonce
+        resil.resize_nonce = resize_nonce
+        templates = (jax.device_get(params), jax.device_get(opt_state),
+                     jax.device_get(state))
+        p_h, o_h, s_h = resil.coord_restore(grant, *templates,
+                                            ack_name="resize")
+        params = place_p(p_h)
+        opt_state = place_o(o_h)
+        state = place_replicated(s_h, mesh)
+        sample_key, drop_key = _fold_keys(retry_nonce, resize_nonce)
+        start_epoch = epoch = loss_base = restart
+        _apply_tune({}, "resize",
+                    {"world": grant.get("world"), "trigger": "rejoin"},
+                    restart)
+        resil._emit("resize", epoch=int(grant["epoch"]),
+                    old_world=int(grant["old_world"]),
+                    world=int(grant["world"]),
+                    members=[int(r) for r in grant["members"]],
+                    lost=[], slots=[int(s) for s in grant.get("slots", [])],
+                    trigger="rejoin", nonce=int(resize_nonce),
+                    restart=int(restart), source=str(grant["source"]))
+        log(f"[elastic] rank {coord_rank}: rejoined world "
+            f"{grant.get('world')} (members {grant.get('members')}); "
+            f"parts now "
+            + slot_desc(slot_map, grant.get("members") or [])
+            + f"; replaying from epoch {restart} in lockstep")
+    if tuner is not None and start_epoch > 0 and not joiner:
         # resumed run: reconstruct/adopt the controller history and actuate
         # the levers that were live at the resume point BEFORE the first
         # step — the healed run replays the same schedule deterministically
@@ -1119,8 +1239,15 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
     # BACKWARD (rollback to the last good checkpoint, resilience.py); with
     # --resilience off no hook below fires and the schedule is exactly the
     # historical `for epoch in range(start_epoch, n_epochs)`.
+    # $BNSGCN_EPOCH_THROTTLE_S: minimum wall time per epoch (sleep before
+    # the timed region). A test/demo knob — the elastic e2e harness uses it
+    # to keep a fast CPU run alive long enough for a relaunched rank to pay
+    # its startup cost and rejoin; 0 (default) sleeps nothing.
+    epoch_throttle = float(os.environ.get("BNSGCN_EPOCH_THROTTLE_S", 0) or 0)
     try:
         while epoch < cfg.n_epochs:
+            if epoch_throttle > 0:
+                time.sleep(epoch_throttle)
             if resil is not None:
                 resil.watchdog.beat(epoch)
                 # deterministic fault injection at the step boundary
@@ -1251,7 +1378,8 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                             "step_ms": round(dt * 1e3, 3)}
                            if obs is not None else None)
                 decision = resil.agree_step(epoch, local, loss_f,
-                                            summary=summary)
+                                            summary=summary,
+                                            final=epoch + 1 >= cfg.n_epochs)
                 act = decision["decision"]
                 if act == "abort":
                     resil.raise_abort(decision)
@@ -1301,7 +1429,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                     params = place_p(p_h)
                     opt_state = place_o(o_h)
                     state = place_replicated(s_h, mesh)
-                    sample_key, drop_key = _fold_keys(retry_nonce)
+                    sample_key, drop_key = _fold_keys(retry_nonce, resize_nonce)
                     if restart < loss_base:
                         res.losses.clear()
                         loss_base = restart
@@ -1321,6 +1449,54 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                     resil.watchdog.touch()      # restore+ack was boundary
                     epoch = restart             # work, not step time
                     continue
+                if act == "resize":
+                    # ---- elastic RESIZE verdict (--elastic on): shrink
+                    # after a heartbeat-detected rank loss, or grow when a
+                    # lost rank rejoins. Every surviving rank re-maps the P
+                    # parts onto the new membership (decision['slots'],
+                    # mesh.plan_slots — no METIS rerun), restores the
+                    # agreed checkpoint, rebuilds the step fns through the
+                    # shared layout cache like a retune, refolds the
+                    # sampling/dropout streams under the resize nonce, and
+                    # keeps training. ----
+                    coordinator.apply_resize(decision)
+                    templates = (jax.device_get(params),
+                                 jax.device_get(opt_state),
+                                 jax.device_get(state))
+                    p_h, o_h, s_h = resil.coord_restore(decision, *templates,
+                                                        ack_name="resize")
+                    restart = int(decision["restart"])
+                    retry_nonce = int(decision["retry_nonce"])
+                    resize_nonce = int(decision["nonce"])
+                    slot_map = (tuple(int(s) for s in decision["slots"])
+                                if decision.get("slots") else None)
+                    params = place_p(p_h)
+                    opt_state = place_o(o_h)
+                    state = place_replicated(s_h, mesh)
+                    sample_key, drop_key = _fold_keys(retry_nonce,
+                                                      resize_nonce)
+                    if restart < loss_base:
+                        res.losses.clear()
+                        loss_base = restart
+                    else:
+                        del res.losses[restart - loss_base:]
+                    # rebuild unconditionally: the halo spec must adopt the
+                    # new slot map even when no tune lever moved (rewind
+                    # returns {} then); _apply_tune invalidates the halo
+                    # cache, re-arms strict-exec, and touches the watchdog
+                    _td = tuner.rewind(restart) if tuner is not None else {}
+                    _apply_tune(_td or {}, "resize",
+                                {"world": decision.get("world"),
+                                 "trigger": decision.get("trigger")},
+                                restart)
+                    log(f"[elastic] epoch {epoch}: "
+                        f"{decision.get('trigger')} resize to world "
+                        f"{decision.get('world')} "
+                        f"(members {decision.get('members')}); parts now "
+                        + slot_desc(slot_map, decision.get("members") or [])
+                        + f"; resuming from epoch {restart}")
+                    epoch = restart
+                    continue
             elif bad:
                 p_h, o_h, s_h, restart, retry_nonce = resil.rollback(
                     epoch, loss_f, jax.device_get(params),
@@ -1328,7 +1504,7 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                 params = place_p(p_h)
                 opt_state = place_o(o_h)
                 state = place_replicated(s_h, mesh)
-                sample_key, drop_key = _fold_keys(retry_nonce)
+                sample_key, drop_key = _fold_keys(retry_nonce, resize_nonce)
                 # retried epochs get re-recorded on the healthy pass
                 if restart < loss_base:
                     res.losses.clear()
@@ -1514,9 +1690,14 @@ def run_training(cfg: Config, g: Optional[Graph] = None,
                         epoch, mt, mc, tag, mr, loss_f))
 
             wrote_ckpt = False
-            if (epoch + 1) % cfg.log_every == 0 and is_rank0:
+            if (epoch + 1) % cfg.log_every == 0 and is_rank0 and not bad:
                 # periodic checkpoint regardless of eval, so --no-eval runs
-                # resume too; rank 0 only (reference train.py:427-428)
+                # resume too; rank 0 only (reference train.py:427-428).
+                # `not bad` is vacuous at the default verdict cadence (a
+                # diverged epoch rolled back above before reaching here)
+                # but load-bearing under $BNSGCN_COORD_AGREE_EVERY > 1:
+                # a latched-not-yet-agreed NaN state must never become
+                # the newest "last good" checkpoint
                 ckpt.save_checkpoint(ckpt.periodic_path(cfg, epoch),
                                      params=params, opt_state=opt_state,
                                      bn_state=state, epoch=epoch,

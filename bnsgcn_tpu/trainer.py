@@ -350,7 +350,8 @@ def _compose_split(spmms, pad_inner: int):
 
 def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
                    mesh: Mesh, rate: Optional[float] = None,
-                   layout_cache: Optional[dict] = None
+                   layout_cache: Optional[dict] = None,
+                   slot_map=None
                    ) -> tuple[StepFns, HaloSpec, dict, dict]:
     """Returns (fns, hspec, tables, tables_full); the tables dicts must be
     passed (replicated) to every call. When cfg.spmm == 'ell', merge
@@ -359,7 +360,12 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
 
     `layout_cache`: optional dict shared across calls on the SAME artifacts
     — SpMM layout construction (minutes at bench scale) is memoized under
-    the spmm kind, so e.g. bench's ell and ell+f8g candidates build once."""
+    the spmm kind, so e.g. bench's ell and ell+f8g candidates build once.
+
+    `slot_map`: elastic part -> worker-slot hosting (mesh.plan_slots), stamped
+    onto the HaloSpecs as host-side addressing metadata. Never read inside
+    traced code, so a resize rebuild reuses the layout cache AND compiles the
+    exact same step program — graftlint-ir's slot-map section pins this."""
     rate = cfg.sampling_rate if rate is None else rate
     del LAST_BUILD_TIMINGS[:]           # this call's stage timings
     halo_strategy = cfg.halo_exchange
@@ -397,7 +403,7 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
             "(use --replicas 1 --feat 1 across hosts)")
     hspec, tables = make_halo_spec(art.n_b, art.pad_inner, art.pad_boundary, rate,
                                    strategy=halo_strategy, wire=cfg.halo_wire,
-                                   replica_axis=rep_axis)
+                                   replica_axis=rep_axis, slot_map=slot_map)
     hspec_full, tables_full = full_rate_spec(art.n_b, art.pad_inner, art.pad_boundary)
     # staleness-bounded halo communication (--halo-refresh K / --halo-mode):
     # K > 1 builds a second, ~K-x-smaller exchange geometry for the
@@ -422,7 +428,8 @@ def build_step_fns(cfg: Config, spec: ModelSpec, art: PartitionArtifacts,
     if refresh_k > 1:
         hspec_r, tables_refresh = make_refresh_spec(
             art.n_b, art.pad_inner, art.pad_boundary, rate, refresh_k,
-            strategy=halo_strategy, wire=cfg.halo_wire, replica_axis=rep_axis)
+            strategy=halo_strategy, wire=cfg.halo_wire, replica_axis=rep_axis,
+            slot_map=slot_map)
     n_train = max(art.n_train, 1)
     multilabel = art.multilabel
     axis = hspec.axis_name

@@ -413,6 +413,13 @@ def test_ir_cli_smoke_subset(tmp_path):
     events = [json.loads(l) for l in log.read_text().splitlines()]
     ev = [e for e in events if e["kind"] == "ir_audit"]
     assert len(ev) == 1 and ev[0]["ok"] is True and ev[0]["n_variants"] == 2
+    # elastic slot-map invariance rides every audit: the first variant is
+    # re-traced under part -> slot maps for two world sizes and must keep
+    # the identical (and rank-symmetric) collective schedule
+    sw = data["slot_worlds"]
+    assert [r["world"] for r in sw] == [2, 4]
+    assert all(r["findings"] == 0 for r in sw)
+    assert len({r["collectives"] for r in sw}) == 1
 
 
 @pytest.mark.quickgate
@@ -435,6 +442,12 @@ def test_ir_audit_clean_at_head(tmp_path):
     assert "padded/native/ovl-off/K1/exchange" in keys
     assert any(k.endswith("grad-only") for k in keys)
     assert any("/K4/" in k for k in keys)             # tune-reachable rung
+    # RESIZE survivors recompile through the same layout cache: the
+    # slot-mapped retraces (W=2 shrink and W=4 identity) must already be
+    # schedule-identical at HEAD, or an elastic verdict would silently
+    # change the program a survivor runs
+    assert [r["world"] for r in data["slot_worlds"]] == [2, 4]
+    assert all(r["findings"] == 0 for r in data["slot_worlds"])
     # every exchange program's traced payload matched its oracle
     for row in data["variants"]:
         for name, prog in row["programs"].items():

@@ -92,6 +92,7 @@ SEEDED = [
     ("retire-horizon-1", "retirement-lag", "proto-retired-live-key"),
     ("pin-before-get", "file-relaunch", "proto-exit-code"),
     ("reduce-order-flipped", "agree-worst-wins", "proto-reduce-order"),
+    ("rejoin-token-unchecked", "rejoin-stale-token", "proto-exit-code"),
 ]
 
 
@@ -121,6 +122,37 @@ def test_unknown_seed_bug_and_bad_spec_raise():
         run_replay("not-a-spec")
     with pytest.raises(ValueError, match="unknown scenario"):
         run_proto_audit(scenarios=["no-such-scenario"])
+
+
+# ----------------------------------------------------------------------------
+# elastic RESIZE scenarios: the default schedules pin the verdict shapes
+# ----------------------------------------------------------------------------
+
+def test_elastic_scenarios_resize_through_rank_loss(tmp_path):
+    """crash-during-resize fault 'shrink' (rank 2 dies at its first
+    heartbeat): both survivors finish DONE — no exit code at all — on the
+    same shrunken member set, restored at the agreed epoch."""
+    s = next(x for x in ALL_SCENARIOS if x.name == "crash-during-resize")
+    assert [n for n, _ in s.faults()][1] == "shrink"
+    rec = run_schedule(s, 1, [], str(tmp_path), None)
+    assert rec.outcomes[2] == ("crashed",)
+    vals = {r: json.loads(o[1]) for r, o in rec.outcomes.items()
+            if o[0] == "done"}
+    assert set(vals) == {0, 1}
+    assert all(v == {"resizes": 1, "members": [0, 1]} for v in vals.values())
+
+
+def test_elastic_scenario_rejoin_skips_stale_grant(tmp_path):
+    """rejoin-stale-token nominal: the joiner reads the planted stale
+    grant, skips it on the token mismatch, and adopts the fresh one —
+    both ranks converge on the grown member set and the same seq."""
+    s = next(x for x in ALL_SCENARIOS if x.name == "rejoin-stale-token")
+    rec = run_schedule(s, 0, [], str(tmp_path), None)
+    vals = {r: json.loads(o[1]) for r, o in rec.outcomes.items()
+            if o[0] == "done"}
+    assert set(vals) == {0, 1}
+    assert vals[0] == vals[1]
+    assert vals[0]["members"] == [0, 1] and vals[0]["restart"] == 6
 
 
 # ----------------------------------------------------------------------------
@@ -183,7 +215,8 @@ def test_proto_audit_clean_at_head(tmp_path):
     assert data["elapsed_s"] <= 120
     names = {row["name"] for row in data["scenarios"]}
     assert {"agree-ok", "rollback-ack", "file-boot-stale",
-            "file-relaunch"} <= names
+            "file-relaunch", "resize-during-rollback",
+            "crash-during-resize", "rejoin-stale-token"} <= names
     # file-transport scenarios ran the REAL FileTransport
     assert all(row["schedules"] > 0 for row in data["scenarios"])
     # truncation, if any, is recorded — never silent

@@ -171,6 +171,52 @@ def test_obs_report_renders_nan_sanitized_records(tmp_path):
     obs_report.compare(s, s, path, path, write=lines.append)
 
 
+def test_obs_report_elastic_resize_section_and_compare_note(tmp_path):
+    """An elastic run's resize events (every member mirrors the agreed
+    verdict into its own rank log) render as ONE de-duplicated world-size
+    timeline, and --compare flags a resize-trail difference as a NOTE —
+    the trajectories part ways at the shrink epoch by design."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    path = str(tmp_path / "elastic.jsonl")
+    ev = obs_mod.EventLog(path)
+    for rank in (0, 1):     # rank 1's mirror of the same shrink verdict
+        ev.emit("resize", rank=rank, epoch=3, old_world=2, world=1,
+                members=[0], lost=[1], slots=[0, 0], trigger="ranklost",
+                nonce=1, restart=2, source="ckpt_E1.ckpt")
+    ev.emit("resize", rank=0, epoch=5, old_world=1, world=2,
+            members=[0, 1], lost=[], slots=[0, 1], trigger="rejoin",
+            nonce=1, restart=2, source="ckpt_E1.ckpt")
+    ev.close()
+    s = obs_report.summarize(obs_report.load_run([path]))
+    assert len(obs_report._resize_verdicts(s)) == 2     # mirrors collapsed
+    lines = []
+    obs_report.render(s, write=lines.append)
+    text = "\n".join(lines)
+    assert "elastic resizes (2 verdict(s)):" in text
+    assert "2->1   ranklost" in text and "(lost [1])" in text
+    assert "1->2   rejoin" in text
+    assert "r0:[p0,p1]" in text and "r0:[p0] r1:[p1]" in text
+    # --compare: a resized run vs an uninterrupted one gets the NOTE...
+    plain = str(tmp_path / "plain.jsonl")
+    pv = obs_mod.EventLog(plain)
+    pv.emit("epoch", epoch=0, loss=1.0, step_s=0.01)
+    pv.close()
+    sp = obs_report.summarize(obs_report.load_run([plain]))
+    lines = []
+    obs_report.compare(sp, s, plain, path, write=lines.append)
+    note = next(ln for ln in lines if "elastic RESIZE" in ln)
+    assert "A: none" in note and "E3:ranklost 2->1" in note
+    assert "from epoch 3 on" in note
+    # ...while identical resize trails stay silent
+    lines = []
+    obs_report.compare(s, s, path, path, write=lines.append)
+    assert not any("elastic RESIZE" in ln for ln in lines)
+
+
 def test_obs_report_serving_fleet_section(tmp_path):
     """Sharded-serving logs (router rank 0 + backend `.rN` siblings) render
     a per-backend fleet table plus the router fan-out line, while the

@@ -6,7 +6,8 @@
 # code, a sigterm-interrupted + resumed run must reach the uninterrupted
 # run's final loss, and the MULTI-HOST stages drive two real coordinated
 # rank processes (--coord tcp, no XLA collectives needed) through partial
-# SIGTERM and coordinated NaN rollback.
+# SIGTERM, coordinated NaN rollback, and the elastic RESIZE round trip
+# (rank loss -> shrink to W=1 -> relaunch -> grow back to W=2).
 #
 #   JAX_PLATFORMS=cpu tools/fault_matrix.sh [workdir]
 #
@@ -214,6 +215,84 @@ if [ -z "$L0" ] || [ "$L0" != "$L1" ] || [ "$L0" != "$K4_LOSS" ]; then
   echo "FAIL  mh_k4: losses r0='$L0' r1='$L1' single-host='$K4_LOSS'"; FAIL=1
 else
   echo "PASS  mh_k4 ranks match the single-host K=4 healed loss ($L0)"
+fi
+
+# ---- elastic stages: rank LOSS becomes a coordinated RESIZE instead of
+# exit 77. Same harness pair; --elastic on, fast heartbeat-silence
+# detection, and the coord window the e2e suite pins. ----
+export BNSGCN_ELASTIC_DEAD_S=3
+export BNSGCN_COORD_TIMEOUT_S=60
+
+echo "== multi-host elastic: ranklost@E3:r1 -> survivor resizes to W=1 =="
+run_pair mh_shrink "$WORK/ck_el" "$WORK/ck_el" --elastic on \
+  --inject ranklost@E3:r1 --obs-log "$WORK/obs_mh_shrink.jsonl"
+check mh_shrink_r0 0 $RC0
+check mh_shrink_r1 0 $RC1
+grep -q 'world resized to 1 (members \[0\], lost \[1\])' \
+  "$WORK/mh_shrink_r0.log" \
+  || { echo "FAIL  mh_shrink: survivor did not agree the shrink"; FAIL=1; }
+grep -q 'RESULT final_loss=' "$WORK/mh_shrink_r0.log" \
+  || { echo "FAIL  mh_shrink: survivor did not train to completion"; FAIL=1; }
+check_event mh_shrink "$WORK/obs_mh_shrink.jsonl" resize
+ls "$WORK"/ck_el/*.ckpt >/dev/null 2>&1 \
+  || { echo "FAIL  mh_shrink: no checkpoint left behind"; FAIL=1; }
+
+echo "== multi-host elastic: shrink, relaunch rank 1, grow back (2->1->2) =="
+# the documented relaunch contract: the replacement comes up AFTER the
+# shrink verdict, with the SAME CLI minus --inject. Epochs are throttled
+# so the W=1 survivor is still training when the replacement finishes its
+# JAX init; the healed loss must equal a shrink-only replay of the same
+# fault (grow restores the newest checkpoint with NO new nonce).
+EL_ARGS="--elastic on --n-epochs 24"
+grow_rank() {  # grow_rank <rank> <log> [extra args...]
+  local rank=$1 log=$2; shift 2
+  BNSGCN_EPOCH_THROTTLE_S=1.0 python -m bnsgcn_tpu.main $BASE $EL_ARGS \
+    --skip-partition --ckpt-path "$WORK/ck_grow" \
+    --coord tcp --coord-port "$COORD_PORT" --coord-world 2 \
+    --coord-rank "$rank" --obs-log "$WORK/obs_mh_grow.jsonl" \
+    "$@" > "$WORK/$log.log" 2>&1 &
+}
+grow_rank 0 mh_grow_r0
+G0=$!
+grow_rank 1 mh_grow_r1 --inject ranklost@E3:r1
+wait $!; check mh_grow_r1 0 $?
+SEEN=1
+for _ in $(seq 1 240); do
+  grep -q 'world resized to 1' "$WORK/mh_grow_r0.log" && { SEEN=0; break; }
+  sleep 0.5
+done
+[ $SEEN -eq 0 ] \
+  || { echo "FAIL  mh_grow: no shrink verdict on the survivor"; FAIL=1; }
+grow_rank 1 mh_grow_r1b
+G1B=$!
+wait $G0; check mh_grow_r0 0 $?
+wait $G1B; check mh_grow_r1b 0 $?
+COORD_PORT=$((COORD_PORT + 2))
+grep -q 'world resized to 2' "$WORK/mh_grow_r0.log" \
+  || { echo "FAIL  mh_grow: survivor never grew back to W=2"; FAIL=1; }
+grep -q 'rejoined world 2' "$WORK/mh_grow_r1b.log" \
+  || { echo "FAIL  mh_grow: replacement did not rejoin"; FAIL=1; }
+grep -q '"trigger": "rejoin"' "$WORK/obs_mh_grow.jsonl" \
+  || { echo "FAIL  mh_grow: no rejoin resize obs event"; FAIL=1; }
+GROW_LOSS=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/mh_grow_r0.log" | cut -d= -f2)
+R1B_LOSS=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/mh_grow_r1b.log" | cut -d= -f2)
+if [ -z "$GROW_LOSS" ] || [ "$GROW_LOSS" != "$R1B_LOSS" ]; then
+  echo "FAIL  mh_grow: joiner loss '$R1B_LOSS' != survivor '$GROW_LOSS'"
+  FAIL=1
+else
+  echo "PASS  mh_grow joiner bitwise in step ($GROW_LOSS)"
+fi
+# deterministic replay: same fault, NO rejoin, throttle off — the healed
+# trajectory must be independent of wall time and of when the rejoin came
+run_pair mh_grow_rep "$WORK/ck_grow_rep" "$WORK/ck_grow_rep" $EL_ARGS \
+  --inject ranklost@E3:r1
+check mh_grow_rep_r0 0 $RC0
+REP_LOSS=$(grep -o 'RESULT final_loss=[^ ]*' "$WORK/mh_grow_rep_r0.log" | cut -d= -f2)
+if [ -z "$REP_LOSS" ] || [ "$REP_LOSS" != "$GROW_LOSS" ]; then
+  echo "FAIL  mh_grow: replay loss '$REP_LOSS' != round-trip '$GROW_LOSS'"
+  FAIL=1
+else
+  echo "PASS  mh_grow round-trip matches the shrink-only replay ($REP_LOSS)"
 fi
 
 [ $FAIL -eq 0 ] && echo "fault matrix: ALL PASS ($WORK)" \

@@ -55,7 +55,7 @@ from bnsgcn_tpu.obs import EVENT_KINDS, load_events  # noqa: E402
 LIFECYCLE_KINDS = ("inject", "rollback", "preempt", "watchdog_fire",
                    "divergence_abort", "coord_decision", "profile_request",
                    "profile", "halo_refresh", "strict_exec",
-                   "reorder", "layout_build", "tune_decision")
+                   "reorder", "layout_build", "tune_decision", "resize")
 
 # static-preflight verdicts (lint.sh gates 2-4 with --obs-log): the
 # audit that gated a pod run sits in the same log as the run it gated
@@ -168,6 +168,37 @@ def _elide(rows, head=20, tail=15):
     return rows[:head] + rows[-tail:], True
 
 
+def _slots_desc(slots) -> str:
+    """'r0:[p0,p1] r1:[p2,p3]' from a [P] part -> hosting-rank list (the
+    'slots' field a RESIZE verdict carries). Local twin of
+    parallel/replicas.slot_desc — importing it would pull jax into a tool
+    that must render logs on a bare host."""
+    by: dict = {}
+    for p, r in enumerate(slots or []):
+        by.setdefault(int(r), []).append(p)
+    return " ".join(f"r{r}:[{','.join('p%d' % p for p in ps)}]"
+                    for r, ps in sorted(by.items()))
+
+
+def _resize_verdicts(s: dict) -> list[dict]:
+    """De-duplicated RESIZE verdicts in timestamp order: every member
+    (and a grow's joiner) mirrors the same agreed verdict into its own
+    rank log, so a merged multi-rank run carries one event per rank per
+    verdict — collapse them to the verdict itself."""
+    out, seen = [], set()
+    for ev in s["lifecycle"]:
+        if ev["kind"] != "resize":
+            continue
+        key = (int(_num(ev.get("epoch"))), str(ev.get("trigger")),
+               int(_num(ev.get("old_world"))), int(_num(ev.get("world"))),
+               int(_num(ev.get("nonce"))))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(ev)
+    return out
+
+
 def render(s: dict, write=print):
     if s.get("unknown_kinds"):
         write("WARNING: event kinds outside obs.EVENT_KINDS (build skew?): "
@@ -230,6 +261,25 @@ def render(s: dict, write=print):
                   if trig else "")
             write(f"  {int(_num(ev.get('epoch'))):5d}   {ch:<30}  "
                   f"{ev.get('reason')}{tr}")
+    # elastic RESIZE verdicts as a world-size timeline (also dropped from
+    # the generic lifecycle dump): WHEN the world changed, WHY (ranklost
+    # shrink vs rejoin grow), where training restarted from, and which
+    # rank hosts which parts afterwards
+    rz = _resize_verdicts(s)
+    if rz:
+        write("")
+        write(f"elastic resizes ({len(rz)} verdict(s)):")
+        write("  epoch   world  trigger   restart  source            parts")
+        for ev in rz:
+            lost = [int(r) for r in ev.get("lost") or []]
+            write(f"  {int(_num(ev.get('epoch'))):5d}   "
+                  f"{int(_num(ev.get('old_world')))}->"
+                  f"{int(_num(ev.get('world')))}   "
+                  f"{str(ev.get('trigger')):<8}  "
+                  f"{int(_num(ev.get('restart'))):7d}  "
+                  f"{str(ev.get('source')):<16}  "
+                  f"{_slots_desc(ev.get('slots'))}"
+                  + (f"  (lost {lost})" if lost else ""))
     if s["audits"]:
         write("")
         write("preflight audits:")
@@ -338,7 +388,7 @@ def render(s: dict, write=print):
         write(line)
     life = [ev for ev in s["lifecycle"]
             if ev["kind"] not in ("reorder", "layout_build",
-                                  "tune_decision")]
+                                  "tune_decision", "resize")]
     if life:
         write("")
         write("lifecycle:")
@@ -535,6 +585,24 @@ def compare(sa: dict, sb: dict, name_a: str, name_b: str, write=print):
         write(f"  NOTE: --tune retuned the comm stack mid-run "
               f"(A: {_trail(ta)} | B: {_trail(tb)}) — step/wire deltas past "
               f"those epochs are schedule effects, not noise")
+    # elastic-resize divergence: a shrink refolds the sampling/dropout
+    # streams under a fresh resize nonce, so two runs whose RESIZE trails
+    # differ part ways AT the earliest differing resize epoch by design
+    za, zb = _resize_verdicts(sa), _resize_verdicts(sb)
+    if za or zb:
+        def _rtrail(evs):
+            return ", ".join(
+                f"E{int(_num(ev.get('epoch')))}:{ev.get('trigger')} "
+                f"{int(_num(ev.get('old_world')))}->"
+                f"{int(_num(ev.get('world')))}"
+                for ev in evs) or "none"
+        if _rtrail(za) != _rtrail(zb):
+            first = min(int(_num(ev.get("epoch"))) for ev in za + zb)
+            write(f"  NOTE: elastic RESIZE trails differ (A: {_rtrail(za)} "
+                  f"| B: {_rtrail(zb)}) — a shrink refolds the sampling/"
+                  f"dropout streams under a new resize nonce, so loss "
+                  f"deltas from epoch {first} on are the resize effect, "
+                  f"not noise")
     if sa["bench"] or sb["bench"]:
         by = {}
         for tag, s in (("a", sa), ("b", sb)):
