@@ -26,6 +26,25 @@ Checked invariants (rule family 10):
     proto-hang              bounded liveness: every schedule quiesces
                             within the virtual-time budget
 
+Serving-fleet invariants (same family, scenarios router-failover /
+rejoin-stale-incarnation / wal-replay-vs-live-delta drive the REAL
+serve_router.RouterCore over the simulated store):
+
+    proto-duplicate-write   a non-idempotent delta (apply_feat /
+                            apply_delta) is applied at most once per
+                            replica across failover retries and WAL
+                            replay — delivered-unknown sends count as
+                            taken
+    proto-lost-write        every delta the router committed (live or
+                            queued in the failover WAL) reaches each
+                            rejoined replica
+    proto-stale-incarnation a retired incarnation token can never
+                            displace the live registration for its slot
+    proto-serve-availability
+                            requests fail or degrade only with zero
+                            live replicas, rejoin re-admits through WAL
+                            replay + warm-up, and the WAL drains
+
 Entry points: ``run_proto_audit`` / ``run_replay`` (library),
 ``python -m bnsgcn_tpu.analysis proto`` (CLI), `tools/lint.sh` gate 3.
 Findings carry a ``proto://<scenario>#<schedule-hash>`` location and a

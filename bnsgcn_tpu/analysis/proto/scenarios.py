@@ -24,7 +24,7 @@ from types import SimpleNamespace
 
 from bnsgcn_tpu.analysis.proto.sim import (Scheduler, SimNet, SimTransport,
                                            make_file_transport)
-from bnsgcn_tpu.parallel.coord import Coordinator, _host
+from bnsgcn_tpu.parallel.coord import Coordinator, CoordTimeout, _host
 
 # Small per-exchange bound: virtual seconds are free, but the poll/backoff
 # loops still execute — a short window keeps the op count per schedule low.
@@ -812,10 +812,484 @@ class FileRelaunch(Scenario):
         return []
 
 
+# ----------------------------------------------------------------------------
+# serving-fleet scenarios (the REAL RouterCore over the SimNet store)
+# ----------------------------------------------------------------------------
+#
+# The self-healing serving router (serve_router.RouterCore) is the other
+# distributed protocol in the tree: health-checked failover, the at-most-
+# once write fan-out, the failover WAL and the incarnation-token rejoin.
+# These scenarios run the REAL RouterCore with only its two socket seams
+# rebound to the in-memory store — requests are `put` under sv/req/...,
+# answers polled from sv/ack/... — so every health transition, candidate
+# choice, WAL cursor and admission decision explored here is production
+# code. Reaching the store counts as reaching the wire: every timeout is
+# delivered-unknown, exactly the retry_sent=False ambiguity the WAL's
+# taken-set discipline exists for. Each backend puts an
+# `sv/applied/<slot>/<sig>` marker per non-idempotent write BEFORE its
+# ack; those markers in the op trace are the at-most-once ledger the
+# checks read.
+
+
+def _sleep_until(ctx, t: float):
+    while ctx.sched.now < t:
+        ctx.sched.sleep(t - ctx.sched.now)
+
+
+class _SimChan:
+    """Per-replica ordered request/answer channel over the SimNet store —
+    the serving fleet's rpc_line_json stand-in (router side)."""
+
+    def __init__(self, sched: Scheduler, net: SimNet):
+        self.sched = sched
+        self.t = SimTransport(sched, net, 0)
+        self.n: dict[int, int] = {}
+
+    def request(self, slot: int, req: dict) -> dict:
+        n = self.n[slot] = self.n.get(slot, 0) + 1
+        deadline = self.sched.now + TIMEOUT_S
+        self.t.put(f"sv/req/{slot}/{n}", json.dumps(req), deadline)
+        while True:
+            v = self.t.try_get(f"sv/ack/{slot}/{n}", deadline)
+            if v is not None:
+                return json.loads(v)
+            if self.sched.now >= deadline - 1e-9:
+                raise CoordTimeout(
+                    f"sim backend r{slot}: no answer to "
+                    f"{req.get('op')!r} #{n} within {TIMEOUT_S}s")
+            self.sched.sleep(0.01)
+
+
+def _make_serve_core(ctx, n_nodes: int = 4, replicas: int = 1,
+                     down_after: int = 1):
+    """A real RouterCore (health tracking on, degraded=partial) whose
+    write RPC and pooled read clients go through the SimNet channel.
+    Thresholds are pinned here — never read from the environment — so
+    every schedule is deterministic; the wall-clock breaker is unit-test
+    territory (tests/test_serve_failover.py), not schedule exploration."""
+    import numpy as np
+
+    from bnsgcn_tpu import serve_router as sr
+
+    pol = sr.HealthPolicy(0.0)
+    pol.probe_timeout_s = TIMEOUT_S
+    pol.suspect_after = 1
+    pol.down_after = down_after
+    pol.readmit = 1
+    pol.breaker_flaps = 99
+    pol.breaker_window_s = 1e9
+    pol.breaker_hold_s = 0.0
+    pol.spotcheck = 1
+    chan = _SimChan(ctx.sched, ctx.net)
+
+    class _SimRouter(sr.RouterCore):
+        """RouterCore with `_send_write2` (the write RPC) rebound to the
+        channel; everything above the seam — `_fan_part_write_taken`,
+        the WAL record/replay, health notes, admission — is inherited."""
+
+        def _send_write2(self, part, replica, req, timeout_s=None):
+            if self.fleet.endpoint(part, replica) is None:
+                return None, False
+            try:
+                resp = chan.request(int(replica), req)
+            except CoordTimeout:
+                if self.health_policy is not None:
+                    self._note_fail(part, replica,
+                                    f"write {req.get('op')!r}")
+                return None, True   # the put landed: delivered-unknown
+            with self._lock:
+                self.stats["fanout_rpcs"] += 1
+            return resp, True
+
+    core = _SimRouter(owner=np.zeros(n_nodes, dtype=np.int32), n_parts=1,
+                      replicas=replicas, hops=1, log=_silent,
+                      route_timeout_s=TIMEOUT_S, delta_timeout_s=TIMEOUT_S,
+                      health=pol, degraded="partial", wal_cap=16)
+
+    class _ReadClient:
+        def __init__(self, replica: int):
+            self.replica = replica
+
+        def request(self, req, timeout_s=None):
+            return chan.request(self.replica, req)
+
+    core.fleet.client = lambda part, replica: _ReadClient(int(replica))
+    return core, chan
+
+
+def _serve_answer(req: dict) -> dict:
+    op = req.get("op")
+    if op == "predict":
+        n = int(req["node"])
+        return {"ok": True, "node": n, "tier": "A",
+                "scores": [float(n)], "stale": False}
+    if op == "mark":
+        return {"ok": True, "marked": len(req["nodes"]), "frontier": []}
+    if op == "dirty":
+        return {"ok": True, "dirty": 0}
+    return {"ok": True}         # apply_feat / apply_delta / invalidate
+
+
+def _write_sig(req: dict):
+    """Identity of a non-idempotent write — the at-most-once unit."""
+    op = req.get("op")
+    if op == "apply_feat":
+        return f"feat:{int(req['node'])}"
+    if op == "apply_delta":
+        return "edges:" + ",".join(f"{int(u)}-{int(v)}"
+                                   for u, v in req["edges"])
+    return None
+
+
+def _serve_result(ctx, t) -> dict:
+    """Adopt the router's published run summary (all done ranks must
+    return the same value — that IS the agreement invariant)."""
+    while True:
+        v = t.try_get("sv/result", 0.0)
+        if v is not None:
+            return json.loads(v)
+        ctx.sched.sleep(0.01)
+
+
+def _serve_backend_loop(ctx, rank: int, slot: int):
+    """One replica process: consume its channel in order. The `svdie`
+    fault key (slot 0 only) models the process dying at a named write —
+    mode 'apply': the delta was journaled but the ack died with the
+    socket (delivered-unknown, delivered side); mode 'drop': it died
+    before applying (delivered-unknown, dropped side) — then restarting
+    under a fresh incarnation once the router opens the rejoin window.
+    The journal (applied markers) survives the restart; the unread
+    request backlog does not."""
+    t = SimTransport(ctx.sched, ctx.net, rank)
+    svdie = dict((ctx.fault or {}).get("svdie") or {}) if slot == 0 else {}
+    n = 0
+    while True:
+        n += 1
+        key = f"sv/req/{slot}/{n}"
+        while True:
+            v = t.try_get(key, 0.0)
+            if v is not None:
+                break
+            if t.try_get("sv/stop", 0.0) is not None:
+                return _serve_result(ctx, t)
+            ctx.sched.sleep(0.01)
+        req = json.loads(v)
+        sig = _write_sig(req)
+        if sig is not None and sig == svdie.get("sig"):
+            svdie.pop("sig")    # a later replay of this sig must apply
+            if svdie.get("mode") == "apply":
+                t.put(f"sv/applied/{slot}/{sig}", "1", 0.0)
+            while t.try_get("sv/restart", 0.0) is None:
+                ctx.sched.sleep(0.02)
+            t.put(f"sv/hello/{slot}", json.dumps({"inc": "inc-B"}), 0.0)
+            pend = t.dump(f"sv/req/{slot}/", 0.0)
+            n = max([n] + [int(k.rsplit("/", 1)[1]) for k in pend])
+            continue
+        if sig is not None:
+            t.put(f"sv/applied/{slot}/{sig}", "1", 0.0)
+        t.put(f"sv/ack/{slot}/{n}", json.dumps(_serve_answer(req)), 0.0)
+
+
+def _applied_counts(rec, slot: int) -> dict[str, int]:
+    pre = f"sv/applied/{slot}/"
+    counts: dict[str, int] = {}
+    for (_, _, op, key) in rec.trace:
+        if op == "put" and key.startswith(pre):
+            sig = key[len(pre):]
+            counts[sig] = counts.get(sig, 0) + 1
+    return counts
+
+
+def _dup_write_violations(rec, slots) -> list:
+    out = []
+    for slot in slots:
+        for sig, c in sorted(_applied_counts(rec, slot).items()):
+            if c > 1:
+                out.append(Violation(
+                    "proto-duplicate-write",
+                    f"replica r{slot} applied non-idempotent write "
+                    f"{sig!r} {c} times — failover/WAL replay re-sent a "
+                    f"delivered-unknown delta (at-most-once broken)"))
+    return out
+
+
+class RouterFailover(Scenario):
+    """Two replicas of one part behind the health-checked router; one of
+    them dies at an explored point (while idle, before applying a write,
+    in the delivered-unknown window, or is merely slow). Every client
+    request must still be answered `ok` by failover — no failed and no
+    degraded answers while a replica lives — and the feature write must
+    land at most once per replica."""
+
+    name = "router-failover"
+    world = 3
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # replica 0 dies while polling for its very first request
+            ("crash-r0-early", {"crash": [(1, "get", 1, "before")]}),
+            # r0 puts: #1 first predict ack, #2 the applied marker, #3
+            # the write ack — before #2 drops the write cleanly; before
+            # #3 is the delivered-unknown window (applied, ack lost)
+            ("crash-r0-before-apply", {"crash": [(1, "put", 2, "before")]}),
+            ("crash-r0-after-apply", {"crash": [(1, "put", 3, "before")]}),
+            ("crash-r1-mid", {"crash": [(2, "get", 8, "before")]}),
+            # one slow answer still inside the route deadline: answered
+            # by the primary, no markdown, no failover needed
+            ("slow-ack", {"delay": [("sv/ack/0/", 0.15, 1)]}),
+        ]
+
+    def setup(self, ctx):
+        core, chan = _make_serve_core(ctx, replicas=2, down_after=2)
+        core.register_backend(0, 0, "sim", 1, incarnation="inc-r0")
+        core.register_backend(0, 1, "sim", 2, incarnation="inc-r1")
+        ctx.sv = SimpleNamespace(core=core, chan=chan)
+
+    def body(self, ctx, rank):
+        if rank != 0:
+            return _serve_backend_loop(ctx, rank, slot=rank - 1)
+        core = ctx.sv.core
+        t = SimTransport(ctx.sched, ctx.net, 0)
+        bad = []
+        for step, node in enumerate((0, 1, None, 2, 3)):
+            r = (core.update_feat(0, [1.0, 2.0]) if node is None
+                 else core.predict(node, tier="A"))
+            if not r.get("ok") or r.get("status", "ok") != "ok":
+                bad.append([step, r.get("status") or r.get("err")])
+        summary = {"bad": bad,
+                   "failed": core.stats["requests_failed"],
+                   "degraded": core.stats["requests_degraded"]}
+        t.put("sv/result", json.dumps(summary, sort_keys=True), 0.0)
+        t.put("sv/stop", "1", 0.0)
+        return json.loads(json.dumps(summary, sort_keys=True))
+
+    def check(self, rec):
+        v = _dup_write_violations(rec, (0, 1))
+        vals = _done_values(rec)
+        if not vals:
+            return v
+        s = next(iter(vals.values()))
+        if s["bad"] or s["failed"]:
+            v.append(Violation(
+                "proto-serve-availability",
+                f"client requests failed despite a live replica "
+                f"(bad={s['bad']}, failed={s['failed']}) — failover must "
+                f"keep a single backend death invisible to clients"))
+        if s["degraded"]:
+            v.append(Violation(
+                "proto-serve-availability",
+                f"{s['degraded']} request(s) answered degraded while a "
+                f"replica was up — degradation is the zero-live-backend "
+                f"last resort, not a failover substitute"))
+        if rec.fault is None:
+            for slot in (0, 1):
+                got = _applied_counts(rec, slot).get("feat:0", 0)
+                if got != 1:
+                    v.append(Violation(
+                        "proto-lost-write",
+                        f"fault-free run: replica r{slot} applied the "
+                        f"feature write {got} times (expected exactly "
+                        f"once)"))
+        return v
+
+
+class RejoinStaleIncarnation(Scenario):
+    """A backend slot's previous process (incarnation inc-A) died; its
+    respawn registers a fresh token while a zombie of inc-A races the
+    same slot with the old one. In EVERY interleaving the slot must end
+    at the newest incarnation's endpoint, re-admitted `up` — a stale
+    token may flap back in only while it is still current, and is
+    refused the moment a newer registration retired it."""
+
+    name = "rejoin-stale-incarnation"
+    world = 2
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # the zombie re-register lands well after the respawn
+            ("zombie-late", {"zombie_delay": 0.05}),
+            # the respawn itself crash-loops once more: inc-C retires
+            # inc-B too; both stale tokens must stay retired
+            ("respawn-twice", {"b_twice": 1, "zombie_delay": 0.02}),
+        ]
+
+    def setup(self, ctx):
+        core, _ = _make_serve_core(ctx, replicas=1)
+        # pre-history: inc-A registered, crashed, and was marked down
+        core.register_backend(0, 0, "sim", 1, incarnation="inc-A")
+        core._note_fail(0, 0, "sim: process died")
+        ctx.sv = SimpleNamespace(core=core)
+
+    def body(self, ctx, rank):
+        from bnsgcn_tpu.serve_router import RouteError
+        core = ctx.sv.core
+        fault = ctx.fault or {}
+        if rank == 0:
+            # the respawned process: fresh token retires inc-A
+            ctx.sched.sleep(0.01)
+            core.register_backend(0, 0, "sim", 2, incarnation="inc-B")
+            if fault.get("b_twice"):
+                ctx.sched.sleep(0.02)
+                core.register_backend(0, 0, "sim", 4, incarnation="inc-C")
+        else:
+            # the zombie of inc-A racing the respawn with its old token
+            ctx.sched.sleep(float(fault.get("zombie_delay", 0.01)))
+            try:
+                core.register_backend(0, 0, "sim", 3, incarnation="inc-A")
+            except RouteError:
+                pass    # refused: it raced in after its retirement
+        _sleep_until(ctx, 0.5)
+        be = core.fleet.endpoint(0, 0)
+        twice = bool(fault.get("b_twice"))
+        return {"port": be["port"], "inc": core._incarnations[(0, 0)],
+                "state": core.health_snapshot().get("p0.r0"),
+                "expect_port": 4 if twice else 2,
+                "expect_inc": "inc-C" if twice else "inc-B"}
+
+    def check(self, rec):
+        v = []
+        for r, s in sorted(_done_values(rec).items()):
+            if s["port"] != s["expect_port"] or s["inc"] != s["expect_inc"]:
+                v.append(Violation(
+                    "proto-stale-incarnation",
+                    f"rank {r}: slot p0.r0 ended at port {s['port']} "
+                    f"under incarnation {s['inc']!r} — a stale token "
+                    f"displaced the live {s['expect_inc']!r} "
+                    f"registration"))
+                break
+            if s["state"] != "up":
+                v.append(Violation(
+                    "proto-serve-availability",
+                    f"rank {r}: the re-registered backend ended "
+                    f"{s['state']!r}, never re-admitted"))
+                break
+        return v
+
+
+class WalReplayVsLiveDelta(Scenario):
+    """The full outage arc on a single-replica part: a write dies in the
+    delivered-unknown window, the outage writes queue in the failover
+    WAL, a mid-outage read degrades (never fails), the restarted process
+    re-registers and the REAL admission path replays the WAL tail before
+    promoting it. The rejoined replica must hold every committed write
+    exactly once — the delivered-unknown one at most once — and the WAL
+    cursor must be drained."""
+
+    name = "wal-replay-vs-live-delta"
+    world = 2
+
+    def faults(self):
+        return [
+            ("nominal", None),
+            # dies AFTER applying feat:1, before the ack: delivered-
+            # unknown on the delivered side — must count as taken in the
+            # WAL's cursor and never be re-sent
+            ("die-after-apply", {"svdie": {"sig": "feat:1",
+                                           "mode": "apply"}}),
+            # dies BEFORE applying feat:1: delivered-unknown on the
+            # dropped side — the documented at-most-once loss window
+            ("die-before-apply", {"svdie": {"sig": "feat:1",
+                                            "mode": "drop"}}),
+        ]
+
+    def setup(self, ctx):
+        core, chan = _make_serve_core(ctx, replicas=1)
+        core.register_backend(0, 0, "sim", 1, incarnation="inc-A")
+        ctx.sv = SimpleNamespace(core=core, chan=chan)
+
+    def body(self, ctx, rank):
+        if rank != 0:
+            return _serve_backend_loop(ctx, rank, slot=0)
+        core = ctx.sv.core
+        t = SimTransport(ctx.sched, ctx.net, 0)
+        fault = (ctx.fault or {}).get("svdie")
+        core.predict(0, tier="A")
+        core.update_feat(0, [0.5])      # feat:0 — healthy
+        core.update_feat(1, [0.5])      # feat:1 — the death point
+        core.update_feat(2, [0.5])      # feat:2 — outage: WAL queues
+        mid = core.predict(1, tier="A")  # outage read: degraded, not lost
+        state = "up"
+        if fault is not None:
+            t.put("sv/restart", "1", 0.0)
+            while True:
+                v = t.try_get("sv/hello/0", 0.0)
+                if v is not None:
+                    break
+                ctx.sched.sleep(0.01)
+            resp = core.register_backend(
+                0, 0, "sim", 1, incarnation=json.loads(v)["inc"])
+            state = resp["state"]
+        core.update_feat(3, [0.5])      # feat:3 — live again, post-rejoin
+        core.predict(1, tier="A")
+        summary = {"mode": (fault or {}).get("mode"),
+                   "rejoin_state": state,
+                   "mid_status": mid.get("status", "ok"),
+                   "failed": core.stats["requests_failed"],
+                   "degraded": core.stats["requests_degraded"],
+                   "wal_depth": core.wal.depth(0),
+                   "health": core.health_snapshot()}
+        t.put("sv/result", json.dumps(summary, sort_keys=True), 0.0)
+        t.put("sv/stop", "1", 0.0)
+        return json.loads(json.dumps(summary, sort_keys=True))
+
+    def check(self, rec):
+        v = _dup_write_violations(rec, (0,))
+        vals = _done_values(rec)
+        if not vals:
+            return v
+        s = next(iter(vals.values()))
+        counts = _applied_counts(rec, 0)
+        exact = {"feat:0": 1, "feat:2": 1, "feat:3": 1}
+        if s["mode"] is None:
+            exact["feat:1"] = 1
+        for sig, want in sorted(exact.items()):
+            got = counts.get(sig, 0)
+            if got < want:
+                v.append(Violation(
+                    "proto-lost-write",
+                    f"write {sig!r} applied {got} times (expected "
+                    f"{want}) — a delta the router committed (live or "
+                    f"via the WAL) never reached the rejoined replica"))
+        if s["mode"] == "apply" and counts.get("feat:1", 0) == 0:
+            v.append(Violation(
+                "proto-lost-write",
+                "the delivered-unknown write 'feat:1' (applied, ack "
+                "lost) vanished — the replica's journal must survive "
+                "its restart"))
+        if s["failed"]:
+            v.append(Violation(
+                "proto-serve-availability",
+                f"{s['failed']} request(s) failed outright — the outage "
+                f"window must degrade, not fail"))
+        if s["mode"] is not None:
+            if s["rejoin_state"] != "up":
+                v.append(Violation(
+                    "proto-serve-availability",
+                    f"rejoin ended in state {s['rejoin_state']!r} — WAL "
+                    f"replay + warm-up must re-admit the restarted "
+                    f"backend"))
+            if s["wal_depth"]:
+                v.append(Violation(
+                    "proto-serve-availability",
+                    f"{s['wal_depth']} WAL entr(ies) still pending "
+                    f"after rejoin — the replay must drain the slot's "
+                    f"cursor"))
+            if s["mid_status"] != "unavailable":
+                v.append(Violation(
+                    "proto-serve-availability",
+                    f"outage read answered {s['mid_status']!r} — with "
+                    f"the only replica down it must be a tagged "
+                    f"degraded row"))
+        return v
+
+
 ALL_SCENARIOS: tuple[Scenario, ...] = (
     AgreeOk(), AgreePreempt(), AgreeWorstWins(), RollbackAck(),
     RollbackExhausted(), SlowDecide(), BroadcastResume(), CrashVerdict(),
     RetirementLag(), PromotionHandshake(), ResizeDuringRollback(),
     CrashDuringResize(), RejoinStaleToken(), FileBootStale(),
-    FileRelaunch(),
+    FileRelaunch(), RouterFailover(), RejoinStaleIncarnation(),
+    WalReplayVsLiveDelta(),
 )

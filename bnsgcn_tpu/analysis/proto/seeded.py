@@ -35,6 +35,14 @@ clean-at-HEAD gate goes red the same way.
                           seq position and both sides time out a
                           healthy admission
                           -> proto-exit-code on rejoin-stale-token
+    failover-retries-nonidempotent-write
+                          the serving router's write fan-out stops
+                          counting delivered-unknown sends as taken:
+                          a timeout whose request already reached the
+                          wire is queued in the failover WAL anyway,
+                          and the rejoin replay applies the delta a
+                          second time -> proto-duplicate-write on
+                          wal-replay-vs-live-delta
 """
 
 from __future__ import annotations
@@ -142,6 +150,35 @@ def _rejoin_token_unchecked():
         Coordinator.request_rejoin = orig
 
 
+@contextmanager
+def _failover_retries_nonidempotent_write():
+    from bnsgcn_tpu import serve_router as _sr
+
+    orig = _sr.RouterCore._fan_part_write_taken
+
+    def eager(self, part, req):
+        out, taken = [], set()
+        for replica in self.fleet.replicas_of(part):
+            if self.health_policy is not None:
+                hs = self._state_of(part, replica)
+                if hs is not None and hs.state in ("down", "quarantined"):
+                    continue
+            resp, _maybe = self._send_write2(part, replica, req)
+            if resp is not None and resp.get("ok"):
+                out.append(resp)
+                taken.add(replica)
+            # the reverted decision: delivered-unknown no longer counts
+            # as taken — the WAL queues the delta and the rejoin replay
+            # re-sends what the backend may already hold
+        return out, taken
+
+    _sr.RouterCore._fan_part_write_taken = eager
+    try:
+        yield
+    finally:
+        _sr.RouterCore._fan_part_write_taken = orig
+
+
 SEEDED_BUGS = {
     "confirm-removed": _confirm_removed,
     "ack-window-dropped": _ack_window_dropped,
@@ -149,6 +186,8 @@ SEEDED_BUGS = {
     "pin-before-get": _pin_before_get,
     "reduce-order-flipped": _reduce_order_flipped,
     "rejoin-token-unchecked": _rejoin_token_unchecked,
+    "failover-retries-nonidempotent-write":
+        _failover_retries_nonidempotent_write,
 }
 
 

@@ -211,7 +211,13 @@ class Config:
                                         # ranklost (env $BNSGCN_FAULT); CI
                                         # proves every recovery path with it.
                                         # ranklost requires :r<rank> — losing
-                                        # every rank is not a resize
+                                        # every rank is not a resize. Serving
+                                        # kinds fire on the Nth routed data-
+                                        # path request inside one backend:
+                                        # servekill@<N>:p<P>.r<R> (hard exit)
+                                        # | servehang@<N>:p<P>.r<R> (wedge) |
+                                        # servedrop@<N>[:p<P>.r<R>] (torn
+                                        # connection, no response)
     elastic: str = "off"                # 'on': a heartbeat-detected rank
                                         # loss becomes an agreed RESIZE
                                         # verdict (survivors re-host the P
@@ -302,6 +308,34 @@ class Config:
                                         # with / clients connect to, as
                                         # 'host:port' (default
                                         # 127.0.0.1:{serve_port})
+    serve_degraded: str = "off"         # router answer when a part has no
+                                        # live backend: 'off' = named
+                                        # RouteError (PR-16 protocol),
+                                        # 'partial' = per-node
+                                        # status:"unavailable" rows, the rest
+                                        # answered; 'stale-ok' = additionally
+                                        # serve tier-A from a non-up replica,
+                                        # tagged status:"stale"
+    serve_probe_s: float = 0.0          # router health-probe cadence in
+                                        # seconds (up/suspect/down states,
+                                        # breaker quarantine, WAL replay on
+                                        # recovery); 0 = probes off —
+                                        # evict-on-error exactly as PR 16.
+                                        # Thresholds are env knobs:
+                                        # BNSGCN_SERVE_{SUSPECT_AFTER,
+                                        # DOWN_AFTER,READMIT,BREAKER_FLAPS,
+                                        # BREAKER_WINDOW_S,BREAKER_HOLD_S,
+                                        # PROBE_TIMEOUT_S}
+    serve_hedge: str = "off"            # 'on' = hedge tier-A reads: fire a
+                                        # second replica after a p99-derived
+                                        # delay, first answer wins, loser
+                                        # cancelled (reads only — writes stay
+                                        # at-most-once)
+    serve_wal_cap: int = 256            # router-side WAL bound: queued
+                                        # delta writes per DOWN part before
+                                        # new writes fail loudly (replayed in
+                                        # order on recovery; only active with
+                                        # --serve-degraded != off)
     # --- continual training on an evolving graph (continual.py +
     # data/incremental.py; `python -m bnsgcn_tpu.main continual ...`).
     # All defaults are inert: a run that never passes --warm-start /
@@ -559,6 +593,24 @@ def create_parser() -> argparse.ArgumentParser:
     both("serve-router", type=str, default="",
          help="router 'host:port' a serve-backend registers with (default "
               "127.0.0.1:{serve-port})")
+    both("serve-degraded", type=str, default="off",
+         choices=["off", "partial", "stale-ok"],
+         help="router behavior for a part with no live backend: 'off' = "
+              "named RouteError (PR-16), 'partial' = per-node "
+              "status:'unavailable' rows while the rest answer, 'stale-ok' "
+              "= also serve possibly-stale tier-A from a non-up replica, "
+              "tagged status:'stale'")
+    both("serve-probe-s", type=float, default=0.0,
+         help="router health-probe cadence in seconds (up/suspect/down, "
+              "breaker quarantine, rejoin warm-up + WAL replay); 0 = "
+              "probes off, evict-on-error exactly as PR 16 "
+              "(thresholds: BNSGCN_SERVE_* env knobs)")
+    both("serve-hedge", type=str, default="off", choices=["off", "on"],
+         help="hedge tier-A fleet reads: fire a second replica after a "
+              "p99-derived delay, first answer wins, loser cancelled")
+    both("serve-wal-cap", type=int, default=256,
+         help="bounded router-side WAL: queued delta writes per down part "
+              "before writes fail loudly (replayed in order on recovery)")
     # continual training (continual.py; `continual` subcommand)
     both("cycle-epochs", type=int, default=5,
          help="fine-tune epochs per continual cycle")

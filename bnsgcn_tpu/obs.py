@@ -67,6 +67,11 @@ EVENT_KINDS = (
     # serving (serve.py; serve_router.py / serve_backend.py for the
     # partition-sharded fleet)
     "serve_header", "serve_drain", "delta", "serve_fleet", "serve_compact",
+    # serving-fleet self-healing (serve_router.py): 'serve_health' = one
+    # backend's up/suspect/down/quarantined transition with the probe
+    # evidence; 'failover' = a read answered by a non-primary replica, a
+    # degraded answer, or a WAL replay — the router's recovery actions
+    "serve_health", "failover",
     # continual training on an evolving graph (continual.py ingestion/
     # promotion cycle; serve.py emits 'promote' at the adoption boundary)
     "continual_cycle", "artifact_update", "promote",

@@ -50,10 +50,10 @@ import time
 from typing import Callable, Optional
 
 __all__ = [
-    "CoordError", "CoordTimeout", "CoordAbort", "Coordinator",
-    "TcpTransport", "FileTransport", "make_coordinator",
+    "CoordError", "CoordTimeout", "CoordAbort", "CoordCancelled",
+    "Coordinator", "TcpTransport", "FileTransport", "make_coordinator",
     "STATE_PRIORITY", "reduce_states",
-    "LineJsonServer", "rpc_line_json",
+    "LineJsonServer", "rpc_line_json", "probe_line_json",
 ]
 
 
@@ -71,6 +71,13 @@ class CoordAbort(CoordError):
     """The ranks agreed to abort (a peer cannot restore the chosen state,
     or a peer reported an unrecoverable fault). main.py maps this to
     EXIT_COORD_ABORT (78) — needs triage, not a blind requeue."""
+
+
+class CoordCancelled(CoordError):
+    """An in-flight pooled request was cancelled from another thread
+    (LineJsonClient.cancel) — the hedged-read loser path. Distinct from
+    CoordTimeout so callers never mistake a deliberate abort for a dead
+    peer and mark the backend unhealthy."""
 
 
 # local step-boundary states, worst-wins; the agreed decision is the reduce
@@ -182,6 +189,10 @@ class _LineJsonHandler(socketserver.StreamRequestHandler):
                     # a handler bug answers the one request with an error —
                     # it never takes the server (or its siblings) down
                     resp = {"ok": False, "err": f"{type(ex).__name__}: {ex}"}
+                if resp is None:
+                    # the handler opted to tear the connection without a
+                    # response (serving-fault injection: 'servedrop')
+                    return
                 self.wfile.write(json.dumps(resp).encode() + b"\n")
                 self.wfile.flush()
         except (OSError, ValueError, KeyError):
@@ -253,18 +264,26 @@ def rpc_line_json(addr: str, port: int, req: dict, deadline: float,
                 return json.loads(line)
         except (OSError, ValueError) as ex:
             if sent and not retry_sent:
-                raise CoordTimeout(
+                err = CoordTimeout(
                     f"{what} at {addr}:{port} accepted op "
                     f"{req.get('op')!r} but the response was lost "
                     f"({type(ex).__name__}: {ex}); not re-sending a "
                     f"non-idempotent request — check server state before "
-                    f"retrying") from ex
+                    f"retrying")
+                # the payload reached the wire: the server MAY have applied
+                # it. Callers that queue failed writes for replay (the
+                # router's failover WAL) must treat this as
+                # delivered-unknown, never as safe-to-resend.
+                err.request_sent = True
+                raise err from ex
         if sent and not retry_sent:
             # connection closed with no response line: same at-most-once rule
-            raise CoordTimeout(
+            err = CoordTimeout(
                 f"{what} at {addr}:{port} closed the connection after op "
                 f"{req.get('op')!r} was sent; not re-sending a "
                 f"non-idempotent request")
+            err.request_sent = True
+            raise err
         time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
         delay = min(delay * 2, 1.0)
 
@@ -290,6 +309,10 @@ class LineJsonClient:
         self._lock = threading.Lock()
         self._sock = None           # guarded-by: self._lock
         self._rfile = None          # guarded-by: self._lock
+        self._cancelled = False     # set lock-FREE by cancel(); read by
+                                    # the in-flight request holding _lock
+        self._cancel_sock = None    # lock-FREE alias of _sock for cancel()
+                                    # (atomic ref read; see cancel())
 
     def _connect_locked(self):
         s = socket.create_connection((self.addr, self.port),
@@ -297,6 +320,7 @@ class LineJsonClient:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.settimeout(self.timeout_s)
         self._sock, self._rfile = s, s.makefile("rb")
+        self._cancel_sock = s
 
     def _close_locked(self):
         for f in (self._rfile, self._sock):
@@ -305,7 +329,7 @@ class LineJsonClient:
                     f.close()
                 except OSError:
                     pass
-        self._sock = self._rfile = None
+        self._sock = self._rfile = self._cancel_sock = None
 
     def _round_trip_locked(self, payload: bytes) -> dict:
         if self._sock is None:
@@ -320,9 +344,18 @@ class LineJsonClient:
         """One idempotent round trip; retries once on a fresh connection."""
         payload = json.dumps(req).encode() + b"\n"
         with self._lock:
+            self._cancelled = False
             try:
                 return self._round_trip_locked(payload)
             except (OSError, ValueError):
+                if self._cancelled:
+                    # deliberate abort from cancel(): do NOT retry — the
+                    # caller (a hedged-read loser) wants out, and a retry
+                    # would re-issue a request nobody is waiting for
+                    self._close_locked()
+                    raise CoordCancelled(
+                        f"{self.what} at {self.addr}:{self.port} request "
+                        f"(op {req.get('op')!r}) cancelled in flight")
                 # stale pooled socket (idle-timeout FIN, peer restart):
                 # retry exactly once over a fresh connection
                 self._close_locked()
@@ -330,14 +363,63 @@ class LineJsonClient:
                     return self._round_trip_locked(payload)
                 except (OSError, ValueError) as ex:
                     self._close_locked()
+                    if self._cancelled:
+                        raise CoordCancelled(
+                            f"{self.what} at {self.addr}:{self.port} "
+                            f"request (op {req.get('op')!r}) cancelled in "
+                            f"flight") from ex
                     raise CoordTimeout(
                         f"{self.what} at {self.addr}:{self.port} "
                         f"unreachable (op {req.get('op')!r}): "
                         f"{type(ex).__name__}: {ex}") from ex
 
+    def cancel(self):
+        """Abort the in-flight request from ANOTHER thread: shuts the
+        pooled socket down so the blocked read fails now, and the victim
+        raises CoordCancelled instead of retrying. Deliberately lock-free
+        — the victim holds `_lock` for the whole round trip, so taking it
+        here would deadlock until the timeout this call exists to beat.
+        A no-op when nothing is in flight."""
+        self._cancelled = True
+        s = self._cancel_sock
+        if s is not None:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
     def close(self):
         with self._lock:
             self._close_locked()
+
+
+def probe_line_json(addr: str, port: int, timeout_s: float = 1.0,
+                    what: str = "backend") -> dict:
+    """One liveness probe against a LineJsonServer: a single fresh-socket
+    ping with NO retry and NO backoff — the health checker's primitive.
+
+    Deliberately not pooled and not `rpc_line_json` (which retries until a
+    deadline): a probe must report THIS attempt's truth, because the
+    caller's consecutive-failure counter is the retry policy. Returns
+    `{"ok": True, "rtt_s": ...}` plus the server's ping payload, or
+    `{"ok": False, "err": ...}` on any failure within `timeout_s`."""
+    t0 = time.monotonic()
+    try:
+        with socket.create_connection((addr, port), timeout=timeout_s) as s:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(timeout_s)
+            s.sendall(b'{"op": "ping"}\n')
+            line = s.makefile("rb").readline(1 << 20)
+        resp = json.loads(line) if line else None
+        if not (isinstance(resp, dict) and resp.get("ok")):
+            return {"ok": False,
+                    "err": f"{what} at {addr}:{port} answered {resp!r}"}
+        resp["rtt_s"] = time.monotonic() - t0
+        return resp
+    except (OSError, ValueError) as ex:
+        return {"ok": False,
+                "err": f"{what} at {addr}:{port}: "
+                       f"{type(ex).__name__}: {ex}"}
 
 
 def _kv_handle(store: _KVStore, req: dict) -> dict:

@@ -63,6 +63,7 @@ from __future__ import annotations
 
 import faulthandler
 import os
+import re
 import signal
 import sys
 import threading
@@ -88,6 +89,13 @@ EXIT_COORD_ABORT = 78  # ranks agreed to abort: a peer cannot restore the
                        # triage, not a blind requeue
 
 FAULT_KINDS = ("nan", "sigterm", "hang", "ckpt-corrupt", "ranklost")
+
+# serving-fleet faults ride the same --inject spec but fire on request
+# COUNTS, not epochs: `servekill@N:pP.rR` / `servehang@N:pP.rR` kill or
+# wedge backend (part P, replica R) after its Nth routed request;
+# `servedrop@N` tears the connection of every backend's Nth request
+# (a transient network blip — the router's retry path must absorb it)
+SERVE_FAULT_KINDS = ("servekill", "servehang", "servedrop")
 
 
 class PreemptedError(Exception):
@@ -233,6 +241,13 @@ class FaultPlan:
         firing would make a CI fault run vacuously green."""
         plan = FaultPlan()
         for term in filter(None, (t.strip() for t in spec.split(","))):
+            kind = term.partition("@")[0]
+            if kind in SERVE_FAULT_KINDS:
+                # serving-fleet faults share the spec string but fire on
+                # request counts inside backend processes — validate here
+                # (a typo'd term must fail in EVERY consumer) and skip
+                _parse_serve_term(term)
+                continue
             kind, sep, rest = term.partition("@")
             ep, rsep, rk = rest.partition(":")
             if (not sep or not ep.startswith("E")
@@ -264,6 +279,69 @@ class FaultPlan:
         eps = self.faults.get(kind)
         if eps and epoch in eps:
             eps.discard(epoch)
+            return True
+        return False
+
+    def empty(self) -> bool:
+        return not any(self.faults.values())
+
+
+def _parse_serve_term(term: str) -> tuple[str, int, Optional[tuple]]:
+    """Validate one serving-fault term; returns (kind, nth, target) where
+    target is (part, replica) or None. Grammar: `kind@<N>[:p<P>.r<R>]`.
+    Target validation mirrors `ranklost`: servekill/servehang require an
+    explicit backend target (killing EVERY backend is not a failover
+    test), while servedrop is transient and may stay fleet-wide."""
+    kind, sep, rest = term.partition("@")
+    nth, tsep, tgt = rest.partition(":")
+    if not sep or not nth.isdigit():
+        raise ValueError(
+            f"bad --inject term {term!r}: expected "
+            f"kind@<N>[:p<part>.r<replica>] "
+            f"(serve kinds: {', '.join(SERVE_FAULT_KINDS)})")
+    target = None
+    if tsep:
+        m = re.fullmatch(r"p(\d+)\.r(\d+)", tgt)
+        if not m:
+            raise ValueError(
+                f"bad --inject term {term!r}: backend target must be "
+                f"p<part>.r<replica> (e.g. servekill@5:p0.r1)")
+        target = (int(m.group(1)), int(m.group(2)))
+    if kind in ("servekill", "servehang") and target is None:
+        raise ConfigError(
+            f"--inject term {term!r}: {kind} needs an explicit "
+            f":p<part>.r<replica> target (wedging every backend is not a "
+            f"failover test); use {kind}@<N>:p<part>.r<replica>")
+    return kind, int(nth), target
+
+
+@dataclass
+class ServeFaultPlan:
+    """Parsed serving-fault terms of an `--inject` spec, scoped to ONE
+    backend (part, replica): kind -> set of request ordinals, each fired
+    once. The training twin is `FaultPlan`; both parsers validate every
+    term of a mixed spec so a typo fails loudly in whichever process
+    sees it first."""
+
+    faults: dict = field(default_factory=dict)   # kind -> set of ordinals
+
+    @staticmethod
+    def parse(spec: str, part: int = -1, replica: int = 0) -> "ServeFaultPlan":
+        plan = ServeFaultPlan()
+        for term in filter(None, (t.strip() for t in spec.split(","))):
+            if term.partition("@")[0] not in SERVE_FAULT_KINDS:
+                continue                # a training term; FaultPlan's beat
+            kind, nth, target = _parse_serve_term(term)
+            if target is not None and target != (part, replica):
+                continue                # valid term, targets another backend
+            plan.faults.setdefault(kind, set()).add(nth)
+        return plan
+
+    def pop(self, kind: str, count: int) -> bool:
+        """True exactly once when `kind` is scheduled at request `count`."""
+        ns = self.faults.get(kind)
+        if ns and count in ns:
+            ns.discard(count)
             return True
         return False
 

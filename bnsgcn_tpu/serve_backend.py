@@ -41,6 +41,7 @@ CLI:  python -m bnsgcn_tpu.main serve-backend --dataset ... \
 from __future__ import annotations
 
 import os
+import socket
 import sys
 import threading
 import time
@@ -555,7 +556,47 @@ class BackendCore(serve.ServeCore):
 class BackendServer(serve.ServeServer):
     """serve.ServeServer plus the fan-out/peer op set; client-facing delta
     ops come back as named route-through-the-router errors (BackendCore
-    raises, the base dispatcher's error path answers)."""
+    raises, the base dispatcher's error path answers).
+
+    Fault injection (`--inject servekill@N:p0.r1,...`): the plan counts
+    ROUTED data-path ops only — reads and the pre-routed write fan-out —
+    never ping/stats (the prober must see the truth) and never peer
+    `resolve` (whose timing depends on other backends' prefetch patterns,
+    which would make the Nth-request trigger nondeterministic)."""
+
+    FAULT_OPS = ("predict", "predict_many", "apply_delta", "apply_feat",
+                 "mark")
+
+    def __init__(self, core: serve.ServeCore, port: int, addr: str = "",
+                 log=print,
+                 faults: Optional[resilience.ServeFaultPlan] = None):
+        # set before super().__init__ starts the listener thread
+        self.faults = faults
+        self._fault_count = 0           # guarded-by: self._fault_lock
+        self._fault_lock = threading.Lock()
+        super().__init__(core, port, addr, log=log)
+
+    def _handle(self, req: dict) -> Optional[dict]:
+        fp = self.faults
+        if fp is not None and not fp.empty() \
+                and req.get("op") in self.FAULT_OPS:
+            with self._fault_lock:
+                self._fault_count += 1
+                n = self._fault_count
+            if fp.pop("servekill", n):
+                self.log(f"[inject] servekill at data-path request {n}: "
+                         f"exiting hard (no drain, no journal flush)")
+                os._exit(1)
+            if fp.pop("servehang", n):
+                self.log(f"[inject] servehang at data-path request {n}: "
+                         f"wedging this handler (probes still answer)")
+                time.sleep(3600.0)
+                return None
+            if fp.pop("servedrop", n):
+                self.log(f"[inject] servedrop at data-path request {n}: "
+                         f"tearing the connection without a response")
+                return None
+        return super()._handle(req)
 
     def _dispatch(self, op: Optional[str], req: dict) -> dict:
         core = self.core
@@ -621,16 +662,23 @@ class PeerResolver:
         return c
 
     def __call__(self, part: int, ids: list[int]) -> dict:
-        for attempt in (0, 1):
+        # `resolve` is idempotent, so retrying across fleet-map refreshes
+        # is safe. The backoff rides out the window between a replica
+        # dying and the router's health checker dropping it from the map
+        # the refetch returns (a router without health tracking keeps the
+        # old once-refetched behavior, just with more patience).
+        attempts = 4
+        for attempt in range(attempts):
             client = self._client(part)
             try:
                 resp = client.request({"op": "resolve",
                                        "nodes": [int(v) for v in ids]})
             except coord_mod.CoordTimeout:
-                with self._lock:        # stale map: refetch + retry once
+                with self._lock:        # stale map: refetch + retry
                     self._clients.pop(part, None)
-                if attempt:
+                if attempt == attempts - 1:
                     raise
+                time.sleep(0.25 * (attempt + 1))
                 continue
             if not resp.get("ok"):
                 raise RuntimeError(f"part {part} resolve failed: "
@@ -682,8 +730,17 @@ def build_backend_core(cfg: Config, g: Graph, owner: np.ndarray, params,
                        np.array(logits, copy=True), log=log, obs=obs)
 
 
+def mint_incarnation(part: int, replica: int) -> str:
+    """Process-unique incarnation token for one (part, replica) slot. The
+    router retires the previous token when a new one registers, so a
+    zombie of the old process re-registering later is refused by name."""
+    return (f"p{part}.r{replica}@{socket.gethostname()}:"
+            f"{os.getpid()}:{int(time.time() * 1000)}")
+
+
 def _register_with_router(cfg: Config, port: int, log,
-                          deadline_s: float = 120.0) -> None:
+                          deadline_s: float = 120.0,
+                          incarnation: Optional[str] = None) -> None:
     """Announce (part, replica, addr, port) to the router, retrying while
     it comes up — backend/router start order is free, like the rank
     coordinator's."""
@@ -692,13 +749,16 @@ def _register_with_router(cfg: Config, port: int, log,
         raddr, rport,
         {"op": "register", "part": cfg.serve_part,
          "replica": cfg.serve_replica,
-         "addr": cfg.serve_addr or "127.0.0.1", "port": port},
+         "addr": cfg.serve_addr or "127.0.0.1", "port": port,
+         "incarnation": incarnation},
         time.monotonic() + deadline_s, what="serve router")
     if not resp.get("ok"):
         raise ConfigError(f"router at {raddr}:{rport} rejected "
                           f"registration: {resp.get('err')}")
     log(f"[backend] registered as {resp.get('id')} with the router at "
         f"{raddr}:{rport}"
+        + (f" (health state {resp['state']!r})" if resp.get("state")
+           else "")
         + (f" (fleet waiting on parts {resp['missing_parts']})"
            if resp.get("missing_parts") else ""))
 
@@ -766,12 +826,27 @@ def backend_main(argv=None) -> int:
         action="drain in-flight requests and flush the delta-log shard",
         boundary="request boundary")
     signals.install()
+    faults = None
+    if cfg.inject:
+        try:
+            faults = resilience.ServeFaultPlan.parse(
+                cfg.inject, part=cfg.serve_part, replica=cfg.serve_replica)
+        except (ValueError, ConfigError) as ex:
+            print(f"[config] {ex}", file=sys.stderr)
+            sys.exit(2)
+        if faults.empty():
+            faults = None
+        else:
+            log(f"[backend {core.backend_id}] armed serve fault(s): "
+                f"{sorted(faults.faults)}")
     server = BackendServer(core, cfg.serve_backend_port, cfg.serve_addr,
-                           log=log)
+                           log=log, faults=faults)
     resolver = PeerResolver(*router_endpoint(cfg))
     core.graph.resolver = resolver
     try:
-        _register_with_router(cfg, server.port, log)
+        _register_with_router(cfg, server.port, log,
+                              incarnation=mint_incarnation(
+                                  cfg.serve_part, cfg.serve_replica))
     except (ConfigError, coord_mod.CoordTimeout) as ex:
         print(f"[config] {ex}", file=sys.stderr)
         server.drain(timeout_s=2.0)
@@ -788,9 +863,12 @@ def backend_main(argv=None) -> int:
                 log(f"[backend {core.backend_id}] background refresh "
                     f"failed: {type(ex).__name__}: {ex}")
 
+    refresher = None
     if cfg.serve_refresh_s > 0:
-        threading.Thread(target=_refresher, name="bnsgcn-backend-refresh",
-                         daemon=True).start()
+        refresher = threading.Thread(target=_refresher,
+                                     name="bnsgcn-backend-refresh",
+                                     daemon=True)
+        refresher.start()
 
     log(f"[backend {core.backend_id}] ready on port {server.port}: "
         f"{core.graph.n_own}/{core.graph.n_nodes} nodes owned, delta-log "
@@ -808,6 +886,11 @@ def backend_main(argv=None) -> int:
                 break
     finally:
         stop_refresh.set()
+        if refresher is not None:
+            # a rejoined backend can be mid-XLA refreshing its dirty
+            # backlog; exiting under it aborts the process (C++ terminate
+            # from a live compute thread), so wait the pass out
+            refresher.join(timeout=120.0)
         server.drain()
         core.close()
         resolver.close()

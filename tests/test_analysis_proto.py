@@ -93,6 +93,8 @@ SEEDED = [
     ("pin-before-get", "file-relaunch", "proto-exit-code"),
     ("reduce-order-flipped", "agree-worst-wins", "proto-reduce-order"),
     ("rejoin-token-unchecked", "rejoin-stale-token", "proto-exit-code"),
+    ("failover-retries-nonidempotent-write", "wal-replay-vs-live-delta",
+     "proto-duplicate-write"),
 ]
 
 
@@ -216,7 +218,9 @@ def test_proto_audit_clean_at_head(tmp_path):
     names = {row["name"] for row in data["scenarios"]}
     assert {"agree-ok", "rollback-ack", "file-boot-stale",
             "file-relaunch", "resize-during-rollback",
-            "crash-during-resize", "rejoin-stale-token"} <= names
+            "crash-during-resize", "rejoin-stale-token",
+            "router-failover", "rejoin-stale-incarnation",
+            "wal-replay-vs-live-delta"} <= names
     # file-transport scenarios ran the REAL FileTransport
     assert all(row["schedules"] > 0 for row in data["scenarios"])
     # truncation, if any, is recorded — never silent

@@ -95,9 +95,14 @@ def test_e2e_two_backend_fleet_bitwise_and_clean_shutdown(tmp_path):
     backends = []
     try:
         for part in (0, 1):
+            # --serve-refresh-s 0: the background refresher otherwise races
+            # the post-delta tier-B assertions (it can clean a dirty node in
+            # the ~1s the in-process ref spends compiling between the two
+            # predicts); this test drains via the explicit `flush` op instead
             b = _spawn("serve-backend", args,
                        ["--serve-part", str(part),
                         "--serve-router", f"127.0.0.1:{rport}",
+                        "--serve-refresh-s", "0",
                         "--serve-dir", str(tmp_path / f"sdir{part}")])
             backends.append(b)
             procs.append((f"backend{part}", b))

@@ -295,6 +295,141 @@ else
   echo "PASS  mh_grow round-trip matches the shrink-only replay ($REP_LOSS)"
 fi
 
+# ---- serving-fleet stages: the self-healing router through the real CLI.
+# One health-probing router (degraded=partial) over the 2-part shard map
+# the training stages produced, part replicas = 2; backend p0.r0 is armed
+# with `--inject servekill@3:p0.r0` and dies hard (os._exit, no drain) at
+# its 3rd routed data-path request. The client load must see ZERO failed
+# answers through the kill (read failover), a graph delta landing while
+# the victim is gone must queue in the router's WAL, and the relaunched
+# process — fresh incarnation token, same CLI minus --inject — must
+# rejoin through WAL replay + the bitwise warm-up gate back to 'up'. ----
+SPORT=$((COORD_PORT + 500))
+SRV="--dataset sbm --partition-method random --n-partitions 2 \
+  --model graphsage --n-layers 2 --n-hidden 8 --sampling-rate 0.5 --use-pp \
+  --fix-seed --seed 11 --part-path $WORK/parts --results-path $WORK/res \
+  --ckpt-path $WORK/ck_ref"
+serve_backend() {  # serve_backend <part> <replica> <log> [extra...]
+  local part=$1 rep=$2 log=$3; shift 3
+  python -m bnsgcn_tpu.main serve-backend $SRV \
+    --serve-part "$part" --serve-replica "$rep" \
+    --serve-router "127.0.0.1:$SPORT" \
+    --serve-dir "$WORK/sdir_p${part}r${rep}" \
+    "$@" > "$WORK/$log.log" 2>&1 &
+}
+
+echo "== serve_kill: servekill@3:p0.r0 mid-load -> zero failed answers =="
+python -m bnsgcn_tpu.main serve-router $SRV --serve-port "$SPORT" \
+  --part-replicas 2 --serve-degraded partial --serve-probe-s 0.2 \
+  --obs-log "$WORK/obs_serve.jsonl" > "$WORK/serve_router.log" 2>&1 &
+SRV_ROUTER=$!
+serve_backend 0 0 serve_p0r0 --inject servekill@3:p0.r0
+SRV_P0R0=$!
+serve_backend 0 1 serve_p0r1
+SRV_P0R1=$!
+serve_backend 1 0 serve_p1r0
+SRV_P1R0=$!
+serve_backend 1 1 serve_p1r1
+SRV_P1R1=$!
+python - "$SPORT" <<'PYEOF' > "$WORK/serve_kill.log" 2>&1
+import json, sys, time
+from bnsgcn_tpu import serve
+port = int(sys.argv[1])
+deadline = time.monotonic() + 300
+while True:                                 # fleet complete = no missing parts
+    try:
+        r = serve.request(port, {"op": "fleet"}, timeout_s=2.0)
+        if r.get("ok") and not r.get("missing_parts"):
+            break
+    except Exception:
+        pass
+    assert time.monotonic() < deadline, "fleet never came up"
+    time.sleep(0.5)
+nodes = list(range(10))
+
+def bad_rows(resp):
+    # a row is bad if it failed OR was answered degraded — with a live
+    # replica of every part, neither is acceptable
+    rows = resp["results"] if resp.get("ok") else [resp]
+    return sum(1 for x in rows
+               if not x.get("ok") or x.get("status", "ok") != "ok")
+
+failed, rounds = 0, 0
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:          # load until the kill is detected
+    rounds += 1
+    failed += bad_rows(serve.request(
+        port, {"op": "predict_many", "nodes": nodes}, timeout_s=60.0))
+    h = serve.request(port, {"op": "health"}, timeout_s=5.0)
+    if h["health"].get("p0.r0") in ("down", "quarantined"):
+        break
+    time.sleep(0.1)
+else:
+    raise AssertionError("router never marked p0.r0 down")
+for _ in range(3):                          # post-kill: failover keeps serving
+    failed += bad_rows(serve.request(
+        port, {"op": "predict_many", "nodes": nodes}, timeout_s=60.0))
+# a delta lands while the victim is gone: its slot's WAL must queue it
+r = serve.request(port, {"op": "add_edges",
+                         "edges": [[0, 1], [2, 3], [4, 5], [6, 7]]},
+                  timeout_s=120.0)
+assert r.get("ok"), r
+h = serve.request(port, {"op": "health"}, timeout_s=5.0)
+wal = sum(h["wal_depth"].values())
+print(f"RESULT serve_kill rounds={rounds} failed={failed} "
+      f"p0r0={h['health'].get('p0.r0')} wal_depth={wal}")
+assert failed == 0, f"{failed} client answer(s) failed despite a live replica"
+assert wal > 0, "no WAL entry queued for the dead replica"
+PYEOF
+check serve_kill 0 $?
+wait $SRV_P0R0
+check serve_kill_exit 1 $?      # the victim died hard, not a clean drain
+grep -q '\[inject\] servekill at data-path request 3' "$WORK/serve_p0r0.log" \
+  || { echo "FAIL  serve_kill: no injection line on the victim"; FAIL=1; }
+
+echo "== serve_rejoin: relaunch p0.r0 -> WAL replay, warm-up, back to 'up' =="
+serve_backend 0 0 serve_p0r0b
+SRV_P0R0B=$!
+python - "$SPORT" <<'PYEOF' > "$WORK/serve_rejoin.log" 2>&1
+import json, sys, time
+from bnsgcn_tpu import serve
+port = int(sys.argv[1])
+deadline = time.monotonic() + 300
+while True:                                 # rejoin = p0.r0 re-admitted 'up'
+    h = serve.request(port, {"op": "health"}, timeout_s=5.0)
+    if h["health"].get("p0.r0") == "up":
+        break
+    assert time.monotonic() < deadline, f"p0.r0 stuck: {h['health']}"
+    time.sleep(0.5)
+assert sum(h["wal_depth"].values()) == 0, f"WAL not drained: {h['wal_depth']}"
+stats = serve.request(port, {"op": "stats"}, timeout_s=60.0)
+replayed = stats.get("wal_replayed", 0)
+failed = sum(1 for x in serve.request(
+    port, {"op": "predict_many", "nodes": list(range(10))},
+    timeout_s=60.0)["results"]
+    if not x.get("ok") or x.get("status", "ok") != "ok")
+avail = h["availability"]
+print(f"RESULT serve_rejoin wal_replayed={replayed} failed={failed} "
+      f"availability={avail['availability']} failovers={avail['failovers']}")
+assert replayed > 0, "rejoin admitted p0.r0 without replaying its WAL tail"
+assert failed == 0
+serve.request(port, {"op": "shutdown"}, timeout_s=30.0)
+PYEOF
+SRV_RC=$?
+check serve_rejoin 0 $SRV_RC
+if [ $SRV_RC -ne 0 ]; then
+  # the client never reached the shutdown op: put the fleet down so the
+  # waits below cannot hang the matrix
+  kill $SRV_ROUTER $SRV_P0R0B $SRV_P0R1 $SRV_P1R0 $SRV_P1R1 2>/dev/null
+fi
+wait $SRV_ROUTER;  check serve_router 0 $?
+wait $SRV_P0R0B;   check serve_p0r0b 0 $?
+wait $SRV_P0R1;    check serve_p0r1 0 $?
+wait $SRV_P1R0;    check serve_p1r0 0 $?
+wait $SRV_P1R1;    check serve_p1r1 0 $?
+grep -q 'replayed' "$WORK/serve_router.log" \
+  || { echo "FAIL  serve_rejoin: no WAL replay line on the router"; FAIL=1; }
+
 [ $FAIL -eq 0 ] && echo "fault matrix: ALL PASS ($WORK)" \
   || echo "fault matrix: FAILURES (logs in $WORK)"
 exit $FAIL

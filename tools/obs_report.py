@@ -448,6 +448,19 @@ def render(s: dict, write=print):
                   f"parts x replicas"
                   + (f" | {fleet.get('shutdown_acked')} shutdown ack(s)"
                      if fleet.get("shutdown_acked") is not None else ""))
+            if fleet.get("availability") is not None:
+                write(f"  availability: {_num(fleet.get('availability')):.4f} "
+                      f"(ok {fleet.get('requests_ok')} / degraded "
+                      f"{fleet.get('requests_degraded')} / failed "
+                      f"{fleet.get('requests_failed')}) | "
+                      f"{fleet.get('failovers')} failover(s), p99 "
+                      f"{_num(fleet.get('failover_p99_ms')):.2f} ms | "
+                      f"{fleet.get('recoveries')} recovery(ies)"
+                      + (f", last outage "
+                         f"{_num(fleet.get('recovery_s')):.2f} s"
+                         if fleet.get("recovery_s") is not None else "")
+                      + f" | WAL {fleet.get('wal_queued')} queued / "
+                        f"{fleet.get('wal_replayed')} replayed")
         if shards:
             write("  backend   req(A/B)        A p50/p99 ms    "
                   "B p50/p99 ms    lag p99 s  queue  halo hit/fetch")

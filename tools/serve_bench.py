@@ -96,8 +96,21 @@ def parse_args(argv=None):
     p.add_argument("--variant", default="",
                    help="topology tag on every emitted metric line "
                         "(default: serve1, or serve{N}p with --fleet)")
+    p.add_argument("--chaos", action="store_true",
+                   help="with --fleet: self-host 2 replicas per part with "
+                        "health tracking + '--serve-degraded partial', tear "
+                        "down backend p0.r0 mid-load and rejoin it; reports "
+                        "the ok/degraded/failed availability split, "
+                        "failover p99 and the recovery wall clock as one "
+                        "JSON summary line instead of the latency metrics")
     p.add_argument("--json-only", action="store_true")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.chaos and not args.fleet:
+        p.error("--chaos needs --fleet N (it kills one replica of a "
+                "partition-sharded fleet)")
+    if args.chaos and args.addr:
+        p.error("--chaos self-hosts its victim fleet; drop --addr")
+    return args
 
 
 def _self_host(args, log):
@@ -173,6 +186,154 @@ def _self_host_fleet(args, log):
         rcore.close()
 
     return router, close, g.n_nodes, owned
+
+
+def run_chaos(args, log) -> int:
+    """Self-hosted failover drill: a --fleet-part fleet with TWO replicas
+    per part behind a health-tracking router in degraded 'partial' mode.
+    Mid-load, backend p0.r0 is torn down (listener stopped + every
+    in-flight connection dropped — to the router it is a dead process),
+    a delta lands while it is gone (so the WAL queues for it), then it
+    restarts under a fresh incarnation and must rejoin through WAL replay
+    + the bitwise warm-up gate. Exit 0 iff zero client requests FAILED
+    (degraded answers are fine — that is the contract under test) and the
+    victim recovered to 'up'."""
+    from bnsgcn_tpu import serve_backend as sb
+    from bnsgcn_tpu import serve_router as sr
+    from bnsgcn_tpu.evaluate import full_graph_embeddings
+    cfg = Config(dataset=args.dataset, model=args.model,
+                 n_layers=args.layers, n_hidden=args.hidden,
+                 seed=args.seed, serve_max_batch=args.max_batch,
+                 use_pp=args.model == "graphsage")
+    g, _, _ = load_data(cfg)
+    cfg = cfg.replace(n_feat=g.n_feat, n_class=g.n_class, n_train=g.n_train)
+    spec = spec_from_config(cfg)
+    params, state = init_params(jax.random.key(args.seed), spec)
+    hidden, logits = full_graph_embeddings(params, state, spec, g,
+                                           cfg.edge_chunk)
+    hidden, logits = np.asarray(hidden), np.asarray(logits)
+    rng = np.random.default_rng(args.seed)
+    owner = rng.integers(0, args.fleet, size=g.n_nodes).astype(np.int32)
+    owner[:args.fleet] = np.arange(args.fleet)
+    os.environ.setdefault("BNSGCN_SERVE_DOWN_AFTER", "2")
+    rcore = sr.RouterCore(owner, args.fleet, replicas=2,
+                          hops=spec.n_graph_layers, log=log,
+                          health=sr.HealthPolicy(probe_s=0.15),
+                          degraded="partial")
+    router = sr.RouterServer(rcore, 0, log=log)
+    servers, cores, resolvers = {}, {}, []
+    for part in range(args.fleet):
+        for r in range(2):
+            c = sb.build_backend_core(
+                cfg.replace(serve_part=part, serve_replica=r), g, owner,
+                params, state, log=lambda *a, **k: None,
+                hidden=hidden, logits=logits)
+            s = sb.BackendServer(c, 0, log=log)
+            res = sb.PeerResolver("127.0.0.1", router.port)
+            c.graph.resolver = res
+            rcore.register_backend(part, r, "127.0.0.1", s.port,
+                                   incarnation=f"chaos-p{part}.r{r}#0")
+            servers[(part, r)] = s
+            cores[(part, r)] = c
+            resolvers.append(res)
+    rcore.start_probes()
+    log(f"chaos fleet up: {args.fleet} part(s) x 2 replicas behind router "
+        f"port {router.port}, probes every 0.15s, degraded=partial")
+
+    counts: dict[str, int] = {"ok": 0, "stale": 0, "unavailable": 0,
+                              "failed": 0}
+    fail_errs: list[str] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def _load(tid: int):
+        r = np.random.default_rng(args.seed + 100 + tid)
+        while not stop.is_set():
+            node = int(r.integers(0, g.n_nodes))
+            try:
+                resp = serve.request(router.port, {"op": "predict",
+                                                   "node": node},
+                                     timeout_s=30.0)
+            except Exception as ex:             # noqa: BLE001 — a failed
+                resp = {"ok": False,            # request is a data point
+                        "err": f"{type(ex).__name__}: {ex}"}
+            key = ((resp.get("status") or "ok") if resp.get("ok")
+                   else "failed")
+            with lock:
+                counts[key] = counts.get(key, 0) + 1
+                if key == "failed" and len(fail_errs) < 3:
+                    fail_errs.append(str(resp.get("err", "?")))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=_load, args=(i,))
+               for i in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(1.0)
+        log("[chaos] tearing down backend p0.r0 mid-load")
+        t_kill = time.perf_counter()
+        victim = servers[(0, 0)]
+        # dead-process simulation: drop every in-flight connection without
+        # a response AND refuse new ones
+        victim.server.handle_fn = lambda req: None
+        victim.server.stop()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and rcore.health_snapshot().get(
+                "p0.r0") not in ("down", "quarantined"):
+            time.sleep(0.05)
+        log(f"[chaos] router sees p0.r0 "
+            f"{rcore.health_snapshot().get('p0.r0')!r}; landing a delta "
+            f"while it is gone (WAL must queue it)")
+        serve.request(router.port, {"op": "add_edges", "edges": [[0, 1]]},
+                      timeout_s=120.0)
+        time.sleep(0.4)
+        log("[chaos] restarting p0.r0 under a fresh incarnation")
+        s2 = sb.BackendServer(cores[(0, 0)], 0, log=log)
+        servers[(0, 0)] = s2
+        rcore.register_backend(0, 0, "127.0.0.1", s2.port,
+                               incarnation="chaos-p0.r0#1")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and \
+                rcore.health_snapshot().get("p0.r0") != "up":
+            time.sleep(0.05)
+        recovered = rcore.health_snapshot().get("p0.r0") == "up"
+        recovery_wall_s = time.perf_counter() - t_kill
+        time.sleep(1.0)                 # post-recovery steady state
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    avail = rcore.availability()
+    with rcore._lock:
+        wal_replayed = rcore.stats["wal_replayed"]
+    summary = {"chaos": True, "fleet": args.fleet, "replicas": 2,
+               "client_requests": sum(counts.values()),
+               "client_ok": counts["ok"], "client_stale": counts["stale"],
+               "client_unavailable": counts["unavailable"],
+               "client_failed": counts["failed"],
+               "availability": avail["availability"],
+               "failovers": avail["failovers"],
+               "failover_p99_ms": avail["failover_p99_ms"],
+               "recoveries": avail["recoveries"],
+               "recovery_s": avail["recovery_s"],
+               "recovery_wall_s": round(recovery_wall_s, 3),
+               "wal_replayed": wal_replayed,
+               "recovered": recovered,
+               "first_failures": fail_errs}
+    print(json.dumps(summary, sort_keys=True))
+    for s in servers.values():
+        try:
+            s.drain(timeout_s=2.0)
+        except OSError:
+            pass                        # the victim's first listener is gone
+    for c in cores.values():
+        c.close()
+    for res in resolvers:
+        res.close()
+    router.drain(timeout_s=2.0)
+    rcore.close()
+    return 0 if recovered and counts["failed"] == 0 else 1
 
 
 def _fire(args, port, addr, tier, nodes, latencies, errors):
@@ -278,6 +439,8 @@ def _direct_overhead(args, routed_a_p50, owned, log):
 def main(argv=None):
     args = parse_args(argv)
     log = (lambda *a, **k: None) if args.json_only else print
+    if args.chaos:
+        return run_chaos(args, log)
     variant = args.variant or (f"serve{args.fleet}p" if args.fleet
                                else "serve1")
     tags = {"variant": variant, "backends": args.fleet or 1}

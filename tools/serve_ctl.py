@@ -10,6 +10,9 @@ server down, one JSON answer on stdout per call:
   python tools/serve_ctl.py --port 8471 export-deltas --cursor 1200
   python tools/serve_ctl.py --port 8471 promote --blob /path/promotion.blob
   python tools/serve_ctl.py --port 8471 ping | flush | dirty | shutdown
+  python tools/serve_ctl.py --port 8470 health      # router only: per-
+                                                    # backend health states,
+                                                    # WAL depths, availability
 
 `export-deltas` prints the server's handshake verbatim: `from`/`total`
 are the cursor interval handed over, `snapshot_required` means the
@@ -32,7 +35,7 @@ from bnsgcn_tpu import serve                        # noqa: E402
 from bnsgcn_tpu.parallel import coord as coord_mod  # noqa: E402
 
 OPS = ("ping", "stats", "metrics", "dirty", "flush", "export-deltas",
-       "promote", "shutdown")
+       "promote", "shutdown", "health")
 
 
 def main(argv=None) -> int:

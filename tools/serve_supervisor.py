@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Respawn supervisor for a serving-fleet backend (or any fleet process).
+
+Runs the given command as a child and restarts it whenever it dies a
+death the self-healing router can recover from: each respawned backend
+process mints a FRESH incarnation token, re-registers, replays the
+router's WAL tail for its slot, and passes the bitwise warm-up gate
+before taking traffic again — the supervisor only has to keep the
+process existing.
+
+  python tools/serve_supervisor.py [--max-respawns 10] [--backoff-s 1.0] \
+      -- python -m bnsgcn_tpu.main serve-backend --dataset ... \
+         --serve-part 0 --serve-replica 0 --serve-router 127.0.0.1:8470
+
+Supervision ENDS (no respawn) on:
+  exit 0   clean fleet shutdown (router-forwarded 'shutdown' op)
+  exit 75  graceful SIGTERM/SIGINT drain — the operator asked it to stop
+  exit 2   config error — respawning an unfixable command is a crash loop
+  SIGTERM/SIGINT to the supervisor itself (forwarded to the child)
+
+Everything else (crash, OOM kill, injected 'servekill') respawns after
+an exponential backoff, up to --max-respawns. Exit code: the child's
+last exit code."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import subprocess
+import sys
+import time
+
+NO_RESPAWN = (0, 2, 75)     # clean / config error / graceful drain
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        usage="serve_supervisor.py [options] -- command ...")
+    p.add_argument("--max-respawns", type=int, default=10,
+                   help="give up after this many restarts (the router's "
+                        "circuit breaker quarantines a flapping backend "
+                        "anyway — a tight crash loop helps nobody)")
+    p.add_argument("--backoff-s", type=float, default=1.0,
+                   help="first-restart delay; doubles per respawn, "
+                        "capped at 30s, reset after 60s of uptime")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the backend command, after `--`")
+    args = p.parse_args(argv)
+    cmd = args.command[1:] if args.command[:1] == ["--"] else args.command
+    if not cmd:
+        p.error("no command given (put it after `--`)")
+
+    stopping = {"flag": False}
+    child = {"proc": None}
+
+    def _forward(signum, _frame):
+        stopping["flag"] = True
+        proc = child["proc"]
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+
+    respawns = 0
+    delay = args.backoff_s
+    code = 0
+    while True:
+        t0 = time.monotonic()
+        print(f"[supervisor] starting: {' '.join(cmd)}", flush=True)
+        proc = subprocess.Popen(cmd)
+        child["proc"] = proc
+        code = proc.wait()
+        uptime = time.monotonic() - t0
+        if stopping["flag"]:
+            print(f"[supervisor] stop requested; child exited {code} "
+                  f"after {uptime:.1f}s — not respawning", flush=True)
+            return code
+        if code in NO_RESPAWN:
+            print(f"[supervisor] child exited {code} "
+                  f"({'clean' if code == 0 else 'config error' if code == 2 else 'graceful drain'})"
+                  f" — not respawning", flush=True)
+            return code
+        respawns += 1
+        if respawns > args.max_respawns:
+            print(f"[supervisor] child exited {code}; respawn budget "
+                  f"({args.max_respawns}) spent — giving up", flush=True)
+            return code
+        if uptime >= 60.0:
+            delay = args.backoff_s      # it held for a while: fresh slate
+        print(f"[supervisor] child exited {code} after {uptime:.1f}s; "
+              f"respawn {respawns}/{args.max_respawns} in {delay:.1f}s "
+              f"(the router re-admits it after WAL replay + warm-up)",
+              flush=True)
+        time.sleep(delay)
+        delay = min(delay * 2, 30.0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
